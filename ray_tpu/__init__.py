@@ -1,0 +1,71 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+A ground-up re-design of Ray's capabilities (reference: amaro/ray) for
+JAX/XLA/TPU: tasks, actors, an owned object plane, lease-based scheduling,
+placement groups and device-mesh claims as first-class resources, plus
+Train/Tune/Data/Serve/RLlib-equivalent libraries whose data plane is
+pjit/shard_map-compiled XLA programs with ICI collectives instead of NCCL
+process groups.
+
+Public surface mirrors ``ray``:
+
+    import ray_tpu as rt
+    rt.init()
+
+    @rt.remote
+    def f(x): return x * 2
+
+    rt.get(f.remote(2))  # -> 4
+"""
+
+from ray_tpu.core import (
+    ActorDiedError,
+    ActorError,
+    ActorID,
+    GetTimeoutError,
+    JobID,
+    NodeAffinitySchedulingStrategy,
+    NodeID,
+    ObjectID,
+    ObjectLostError,
+    ObjectRef,
+    ObjectStoreFullError,
+    PlacementGroup,
+    PlacementGroupID,
+    PlacementGroupSchedulingStrategy,
+    TaskCancelledError,
+    TaskError,
+    TaskID,
+    WorkerCrashedError,
+    WorkerID,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    method,
+    nodes,
+    placement_group,
+    put,
+    remote,
+    remove_placement_group,
+    shutdown,
+    wait,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ActorDiedError", "ActorError", "ActorID", "GetTimeoutError", "JobID",
+    "NodeAffinitySchedulingStrategy", "NodeID", "ObjectID", "ObjectLostError",
+    "ObjectRef", "ObjectStoreFullError", "PlacementGroup",
+    "PlacementGroupID", "PlacementGroupSchedulingStrategy",
+    "TaskCancelledError", "TaskError", "TaskID", "WorkerCrashedError",
+    "WorkerID", "available_resources", "cancel", "cluster_resources", "get",
+    "get_actor", "init", "is_initialized", "kill", "method", "nodes",
+    "placement_group", "put", "remote", "remove_placement_group", "shutdown",
+    "wait", "__version__",
+]

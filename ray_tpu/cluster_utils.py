@@ -1,0 +1,74 @@
+"""Multi-node-on-one-host test cluster.
+
+Reference analog: ``python/ray/cluster_utils.py:99`` — the central fixture
+for testing scheduling, spillback, fault tolerance, and node failure without
+real machines: multiple node managers (each with its own worker pool, store,
+and resource ledger) share one control store in the head process.
+``add_node(**resources)`` / ``remove_node(node)`` drive membership.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .core import runtime as runtime_mod
+from .core.ids import NodeID
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[dict] = None):
+        self.head_node_id: Optional[NodeID] = None
+        self._nodes: list = []
+        if initialize_head:
+            args = dict(head_node_args or {})
+            num_cpus = args.pop("num_cpus", 2)
+            self.runtime = runtime_mod.init(num_cpus=num_cpus, **args)
+            self.head_node_id = self.runtime.scheduler.nodes()[0].node_id
+            self._nodes.append(self.head_node_id)
+        else:
+            self.runtime = None
+
+    def add_node(self, num_cpus: float = 2, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 object_store_memory: Optional[int] = None,
+                 topology: Optional[dict] = None,
+                 labels: Optional[dict] = None) -> NodeID:
+        node_resources = {"CPU": float(num_cpus)}
+        if num_tpus:
+            node_resources["TPU"] = float(num_tpus)
+        node_resources.update(resources or {})
+        node_id = self.runtime.add_node(
+            node_resources, object_store_memory=object_store_memory,
+            labels=labels, topology=topology,
+        )
+        self._nodes.append(node_id)
+        return node_id
+
+    def remove_node(self, node_id: NodeID) -> None:
+        """Simulated node failure: workers killed, store destroyed."""
+        self.runtime.remove_node(node_id)
+        if node_id in self._nodes:
+            self._nodes.remove(node_id)
+
+    def wait_for_nodes(self, timeout: float = 30.0) -> None:
+        """Block until every node's worker pool has a registered worker.
+
+        Reference analog: ``Cluster.wait_for_nodes`` — tests that need
+        deterministic placement call this after ``add_node``.
+        """
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            pools_ready = all(
+                any(w._registered.is_set() for w in n.pool.all_workers())
+                for n in self.runtime.scheduler.nodes()
+            )
+            if pools_ready:
+                return
+            time.sleep(0.02)
+        raise TimeoutError("worker pools did not become ready")
+
+    def shutdown(self) -> None:
+        runtime_mod.shutdown()

@@ -1,0 +1,49 @@
+"""Core runtime: tasks, actors, objects, scheduling, control store."""
+
+from .api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    method,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from .exceptions import (
+    ActorDiedError,
+    ActorError,
+    GetTimeoutError,
+    ObjectLostError,
+    ObjectStoreFullError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
+from .object_ref import ObjectRef
+from .placement_group import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroup,
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)
+
+__all__ = [
+    "ActorDiedError", "ActorError", "ActorID", "GetTimeoutError", "JobID",
+    "NodeAffinitySchedulingStrategy", "NodeID", "ObjectID", "ObjectLostError",
+    "ObjectRef", "ObjectStoreFullError", "PlacementGroup",
+    "PlacementGroupID", "PlacementGroupSchedulingStrategy",
+    "TaskCancelledError", "TaskError", "TaskID", "WorkerCrashedError",
+    "WorkerID", "available_resources", "cancel", "cluster_resources", "get",
+    "get_actor", "init", "is_initialized", "kill", "method", "nodes",
+    "placement_group", "put", "remote", "remove_placement_group", "shutdown",
+    "wait",
+]

@@ -1,0 +1,260 @@
+"""Actor classes and handles.
+
+Reference analog: ``python/ray/actor.py`` — ``@remote`` on a class yields an
+:class:`ActorClass`; ``.remote(...)`` submits an actor-creation task and
+returns an :class:`ActorHandle` whose method proxies submit ordered actor
+tasks. Handles pickle as (actor_id, method metadata) and work from any
+process; named actors are resolvable via the control store
+(``GcsActorManager`` named-actor table).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Dict, Optional
+
+from . import serialization
+from .exceptions import ActorError
+from .ids import ActorID
+from .object_ref import ObjectRef
+from .remote_function import (
+    build_args_frame,
+    build_resources,
+    resolve_strategy,
+)
+from .serialization import Serializer
+from .task_spec import TaskSpec, TaskType
+
+# Actors default to 0 CPUs for placement (matching the reference's actor
+# scheduling defaults): the dedicated worker process, not the CPU ledger,
+# is the real constraint; set num_cpus explicitly for CPU-heavy actors.
+_DEFAULT_ACTOR_OPTIONS = dict(
+    num_cpus=0.0,
+    num_tpus=0.0,
+    resources=None,
+    max_restarts=0,
+    max_task_retries=0,
+    max_concurrency=1,
+    name=None,
+    namespace="default",
+    lifetime=None,
+    scheduling_strategy=None,
+    num_returns=1,
+)
+
+
+class ActorMethod:
+    """Proxy for one actor method: ``handle.method.remote(args)``."""
+
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, **overrides) -> "ActorMethod":
+        m = ActorMethod(self._handle, self._method_name,
+                        overrides.get("num_returns", self._num_returns))
+        return m
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit_method(
+            self._method_name, args, kwargs, num_returns=self._num_returns
+        )
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method {self._method_name!r} must be invoked with "
+            f".remote()."
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, methods: Dict[str, dict],
+                 max_task_retries: int = 0, name: Optional[str] = None,
+                 _owned: bool = False):
+        self._actor_id = actor_id
+        self._methods = methods
+        self._max_task_retries = max_task_retries
+        self._name = name
+        # The original driver-side handle owns the actor's lifetime: when it
+        # is GC'd the actor terminates gracefully (reference: actor handles
+        # are reference-counted; out-of-scope -> terminate). Named actors
+        # are exempt (resolvable via get_actor until killed).
+        self._owned = _owned and name is None
+        self._serializer = Serializer(ref_class=ObjectRef)
+
+    def __del__(self):
+        if not getattr(self, "_owned", False):
+            return
+        try:
+            from .runtime import get_head_runtime
+
+            head = get_head_runtime()
+            if head is not None:
+                head.terminate_actor(self._actor_id)
+        except Exception:
+            pass
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        meta = self._methods.get(item)
+        if meta is None:
+            raise AttributeError(
+                f"Actor has no method {item!r}; known: {sorted(self._methods)}"
+            )
+        return ActorMethod(self, item, meta.get("num_returns", 1))
+
+    def _submit_method(self, method_name: str, args, kwargs, num_returns=1):
+        from .runtime import get_runtime
+
+        rt = get_runtime()
+        frame, arg_refs, borrowed = build_args_frame(
+            self._serializer, args, kwargs
+        )
+        from .remote_function import _new_task_id
+
+        spec = TaskSpec(
+            task_id=_new_task_id(rt),
+            task_type=TaskType.ACTOR_TASK,
+            function_blob=None,
+            method_name=method_name,
+            args_frame=frame,
+            arg_refs=arg_refs,
+            borrowed_refs=borrowed,
+            num_returns=num_returns,
+            actor_id=self._actor_id,
+            max_retries=self._max_task_retries,
+            name=f"{self._name or 'actor'}.{method_name}",
+        )
+        refs = rt.submit_spec(spec)
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._methods,
+                              self._max_task_retries, self._name))
+
+    def __repr__(self):
+        return f"ActorHandle({self._name or self._actor_id.hex()[:12]})"
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: Dict[str, Any]):
+        self._cls = cls
+        self._options = dict(_DEFAULT_ACTOR_OPTIONS)
+        self._options.update(options or {})
+        self._cls_blob: Optional[bytes] = None
+        self._serializer = Serializer(ref_class=ObjectRef)
+        functools.update_wrapper(self, cls, updated=[])
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__!r} cannot be instantiated "
+            f"directly; use {self._cls.__name__}.remote()."
+        )
+
+    def options(self, **overrides) -> "ActorClass":
+        new = ActorClass(self._cls, {**self._options, **overrides})
+        new._cls_blob = self._cls_blob
+        return new
+
+    def _method_table(self) -> Dict[str, dict]:
+        methods = {}
+        for name, member in inspect.getmembers(self._cls):
+            if name.startswith("__") and name != "__call__":
+                continue
+            if callable(member):
+                num_returns = getattr(member, "_num_returns", 1)
+                methods[name] = {"num_returns": num_returns}
+        return methods
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from .runtime import auto_init, get_runtime
+
+        auto_init()
+        rt = get_runtime()
+        if self._cls_blob is None:
+            self._cls_blob = serialization.dumps(self._cls)
+        frame, arg_refs, borrowed = build_args_frame(
+            self._serializer, args, kwargs
+        )
+        opts = self._options
+        from .remote_function import _new_task_id
+        from .ids import JobID
+
+        if hasattr(rt, "next_actor_id"):
+            actor_id = rt.next_actor_id()
+        else:
+            actor_id = ActorID.of(JobID.from_int(1))
+        spec = TaskSpec(
+            task_id=_new_task_id(rt),
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            function_blob=self._cls_blob,
+            method_name=self._cls.__name__,  # display only; name= is registry
+            args_frame=frame,
+            arg_refs=arg_refs,
+            borrowed_refs=borrowed,
+            num_returns=1,
+            resources=build_resources(opts),
+            strategy=resolve_strategy(opts),
+            actor_id=actor_id,
+            max_restarts=opts["max_restarts"],
+            max_concurrency=opts["max_concurrency"],
+            name=opts["name"] or "",
+        )
+        rt.submit_spec(spec)
+        handle = ActorHandle(
+            actor_id, self._method_table(),
+            max_task_retries=opts["max_task_retries"],
+            name=opts["name"],
+            _owned=opts["lifetime"] != "detached",
+        )
+        # Publish the handle for named lookup (get_actor); reference:
+        # named-actor table in GCS + serialized handle in internal KV.
+        head = _head_runtime(rt)
+        if head is not None:
+            head.gcs.kv_put(
+                b"actor_handle:" + actor_id.binary(),
+                serialization.dumps(handle), "actors",
+            )
+        return handle
+
+
+def _head_runtime(rt):
+    from .runtime import get_head_runtime
+
+    return get_head_runtime()
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    """Look up a live named actor (reference: ``ray.get_actor``)."""
+    from .runtime import get_head_runtime, get_runtime
+
+    rt = get_runtime()
+    head = get_head_runtime()
+    if head is not None:
+        info = head.gcs.get_named_actor(name, namespace)
+        if info is None:
+            raise ValueError(f"Failed to look up actor {name!r}")
+        blob = head.gcs.kv_get(b"actor_handle:" + info.actor_id.binary(),
+                               "actors")
+        return serialization.loads(blob)
+    # Worker process: RPC to the head.
+    blob = rt._rpc("get_actor", name, namespace)
+    if blob is None:
+        raise ValueError(f"Failed to look up actor {name!r}")
+    return serialization.loads(blob)
+
+
+def method(num_returns: int = 1):
+    """Decorator to set per-method defaults (reference: ``ray.method``)."""
+
+    def decorator(fn):
+        fn._num_returns = num_returns
+        return fn
+
+    return decorator

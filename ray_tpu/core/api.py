@@ -1,0 +1,104 @@
+"""Public core API: init/get/put/wait/remote/kill/cancel.
+
+Reference analog: the top-level ``ray`` module surface
+(``python/ray/_private/worker.py:1023,2192,2305,2361,2685``). Functions
+dispatch to the current process's runtime — the head :class:`Runtime` in the
+driver, the pipe-backed adapter inside worker processes — so the same code
+runs in tasks, actors, and the driver.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+from .actor import get_actor, method
+from .exceptions import ActorError
+from .ids import ActorID
+from .object_ref import ObjectRef
+from .remote_function import remote
+from .runtime import (
+    auto_init,
+    get_head_runtime,
+    get_runtime,
+    init,
+    is_initialized,
+    shutdown,
+)
+
+
+def put(value: Any) -> ObjectRef:
+    """Store a value in the object plane and return a ref to it."""
+    auto_init()
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed")
+    return get_runtime().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    """Fetch object values, blocking until available.
+
+    Raises the task's error (``TaskError``), ``ActorDiedError``,
+    ``ObjectLostError`` (after reconstruction attempts), or
+    ``GetTimeoutError``.
+    """
+    auto_init()
+    if isinstance(refs, list) and not all(isinstance(r, ObjectRef) for r in refs):
+        raise TypeError("get() takes an ObjectRef or a list of ObjectRefs")
+    return get_runtime().get(refs, timeout=timeout)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    """Block until ``num_returns`` of ``refs`` are ready; returns (ready, rest)."""
+    auto_init()
+    refs = list(refs)
+    if len(set(refs)) != len(refs):
+        raise ValueError("wait() requires a list of unique ObjectRefs")
+    return get_runtime().wait(refs, num_returns=num_returns, timeout=timeout,
+                              fetch_local=fetch_local)
+
+
+def kill(actor_handle, *, no_restart: bool = True) -> None:
+    """Forcibly terminate an actor (reference: ``ray.kill``)."""
+    head = get_head_runtime()
+    if head is not None:
+        head.kill_actor(actor_handle._actor_id, no_restart)
+    else:
+        get_runtime().kill_actor(actor_handle._actor_id.binary(), no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    """Cancel a pending/running task (reference: ``ray.cancel``)."""
+    head = get_head_runtime()
+    if head is not None:
+        head.cancel(ref, force)
+    else:
+        get_runtime().cancel(ref.id.binary(), force)
+
+
+def nodes() -> List[dict]:
+    """Cluster membership info (reference: ``ray.nodes``)."""
+    head = get_head_runtime()
+    if head is None:
+        return []
+    return [
+        {
+            "NodeID": n.node_id.hex(),
+            "Alive": n.alive,
+            "Resources": dict(n.resources),
+            "Labels": dict(n.labels),
+            "Topology": dict(n.topology),
+        }
+        for n in head.gcs.nodes.values()
+    ]
+
+
+def cluster_resources() -> dict:
+    head = get_head_runtime()
+    return head.cluster_resources() if head else {}
+
+
+def available_resources() -> dict:
+    head = get_head_runtime()
+    return head.available_resources() if head else {}

@@ -1,0 +1,161 @@
+"""Runtime flag table, env-overridable.
+
+Reference analog: ``src/ray/common/ray_config_def.h`` (167 ``RAY_CONFIG``
+entries read via ``RayConfig::instance()``). Here a declarative table of typed
+flags, each overridable via environment variable ``RT_<NAME>``, plus a
+serialized-dict override path so a head process can propagate one config to
+every daemon it starts (reference: ``--system-config`` flag on raylet/gcs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+
+def _parse_bool(v: str) -> bool:
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: int,
+    float: float,
+    str: str,
+}
+
+
+@dataclass
+class _Flag:
+    name: str
+    type: type
+    default: Any
+    doc: str
+
+
+_FLAGS: Dict[str, _Flag] = {}
+
+
+def _define(name: str, type_: type, default: Any, doc: str) -> None:
+    _FLAGS[name] = _Flag(name, type_, default, doc)
+
+
+# --- Core object/task limits -------------------------------------------------
+_define("max_direct_call_object_size", int, 100 * 1024,
+        "Results/args at or below this many bytes are inlined in-band instead "
+        "of going through the shared-memory store "
+        "(reference: ray_config_def.h max_direct_call_object_size).")
+_define("object_store_memory", int, 2 * 1024**3,
+        "Default per-node shared-memory object store capacity in bytes.")
+_define("object_spilling_threshold", float, 0.8,
+        "Fraction of store capacity at which spilling to disk begins.")
+_define("min_spilling_size", int, 1024 * 1024,
+        "Spill batches are fused until at least this many bytes.")
+_define("object_transfer_chunk_bytes", int, 5 * 1024**2,
+        "Chunk size for node-to-node object push (reference: 5MiB chunks, "
+        "object_manager).")
+_define("max_lineage_bytes", int, 256 * 1024**2,
+        "Cap on retained task specs for lineage reconstruction per worker.")
+
+# --- Scheduling --------------------------------------------------------------
+_define("scheduler_spread_threshold", float, 0.5,
+        "Hybrid policy: pack onto nodes below this utilization, then spread "
+        "(reference: hybrid_scheduling_policy.h).")
+_define("max_pending_lease_requests_per_scheduling_category", int, 10,
+        "In-flight worker-lease requests per scheduling key.")
+_define("worker_lease_timeout_ms", int, 500,
+        "How long an idle leased worker is retained before return.")
+_define("max_tasks_in_flight_per_worker", int, 1,
+        "Pipelined task pushes per leased worker.")
+
+# --- Health / failure --------------------------------------------------------
+_define("num_heartbeats_timeout", int, 30,
+        "Missed heartbeats before a node is marked dead "
+        "(reference: gcs_heartbeat_manager.h).")
+_define("heartbeat_period_ms", int, 100, "Node heartbeat period.")
+_define("task_max_retries", int, 3, "Default retries for failed tasks.")
+_define("actor_max_restarts", int, 0, "Default actor restarts on failure.")
+
+# --- Workers -----------------------------------------------------------------
+_define("num_workers_per_node", int, 0,
+        "Size of each node's worker pool; 0 means use num_cpus.")
+_define("worker_register_timeout_s", int, 30,
+        "Seconds to wait for a spawned worker process to register.")
+_define("prestart_workers", bool, True,
+        "Pre-start the worker pool at node start instead of on demand.")
+_define("idle_worker_killing_time_ms", int, 60_000,
+        "Idle time before surplus workers above the pool floor are reaped.")
+
+# --- Mesh / TPU --------------------------------------------------------------
+_define("mesh_claim_timeout_s", int, 60,
+        "Timeout waiting for a mesh claim (TPU subslice) to be granted.")
+_define("ici_transfer_hint_bytes", int, 64 * 1024**2,
+        "Hint: device arrays above this prefer resharding over host transfer.")
+
+# --- Observability -----------------------------------------------------------
+_define("metrics_report_interval_ms", int, 1000, "Metrics flush interval.")
+_define("event_log_max_bytes", int, 64 * 1024**2, "Structured event log cap.")
+_define("debug_dump_period_ms", int, 10_000,
+        "Period for debug-state dumps (reference: "
+        "debug_dump_period_milliseconds).")
+
+_ENV_PREFIX = "RT_"
+
+
+class Config:
+    """Process-wide config singleton (reference: RayConfig::instance())."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+        for flag in _FLAGS.values():
+            env = os.environ.get(_ENV_PREFIX + flag.name.upper())
+            if env is not None:
+                self._values[flag.name] = _PARSERS[flag.type](env)
+            else:
+                self._values[flag.name] = flag.default
+
+    @classmethod
+    def instance(cls) -> "Config":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    def apply_overrides(self, overrides: Dict[str, Any]) -> None:
+        for k, v in overrides.items():
+            if k not in _FLAGS:
+                raise KeyError(f"Unknown config flag: {k}")
+            self._values[k] = v
+
+    def serialize(self) -> str:
+        return json.dumps(self._values)
+
+    @classmethod
+    def from_serialized(cls, payload: str) -> "Config":
+        cfg = cls()
+        cfg.apply_overrides(json.loads(payload))
+        return cfg
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self.__dict__["_values"][name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def get(self, name: str) -> Any:
+        return self._values[name]
+
+
+def config() -> Config:
+    return Config.instance()

@@ -1,0 +1,89 @@
+"""User-visible error types.
+
+Reference analog: ``python/ray/exceptions.py`` (RayError, RayTaskError,
+RayActorError, ObjectLostError, GetTimeoutError, ...).
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RuntimeError_(Exception):
+    """Base class for framework errors (kept distinct from builtin RuntimeError)."""
+
+
+class TaskError(RuntimeError_):
+    """A task raised an exception; re-raised at ``get`` with remote traceback.
+
+    Reference: RayTaskError wraps the cause and its traceback string so the
+    driver sees where the remote function failed.
+    """
+
+    def __init__(self, cause: BaseException, remote_tb: str = "", task_desc: str = ""):
+        self.cause = cause
+        self.remote_tb = remote_tb
+        self.task_desc = task_desc
+        super().__init__(str(cause))
+
+    def __str__(self):
+        return (
+            f"{type(self.cause).__name__}: {self.cause}\n"
+            f"  (remote task {self.task_desc})\n{self.remote_tb}"
+        )
+
+    @staticmethod
+    def from_exception(exc: BaseException, task_desc: str = "") -> "TaskError":
+        return TaskError(exc, traceback.format_exc(), task_desc)
+
+
+class WorkerCrashedError(RuntimeError_):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorError(RuntimeError_):
+    """An actor task cannot complete because the actor is dead.
+
+    Reference: RayActorError.
+    """
+
+    def __init__(self, actor_id=None, msg: str = "The actor died unexpectedly."):
+        self.actor_id = actor_id
+        super().__init__(msg)
+
+
+class ActorDiedError(ActorError):
+    pass
+
+
+class ObjectLostError(RuntimeError_):
+    """All copies of an object were lost and it could not be reconstructed."""
+
+    def __init__(self, object_id=None, msg: Optional[str] = None):
+        self.object_id = object_id
+        super().__init__(msg or f"Object {object_id} was lost.")
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class GetTimeoutError(RuntimeError_, TimeoutError):
+    """``get(..., timeout=)`` expired before the object was ready."""
+
+
+class TaskCancelledError(RuntimeError_):
+    """The task was cancelled before or during execution."""
+
+
+class ObjectStoreFullError(RuntimeError_):
+    """The shared-memory store is full and spilling could not make room."""
+
+
+class PlacementGroupUnschedulableError(RuntimeError_):
+    """No node (or mesh) satisfies the placement group's bundles."""
+
+
+class MeshClaimError(RuntimeError_):
+    """A requested device-mesh claim cannot be satisfied by the topology."""

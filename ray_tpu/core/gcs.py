@@ -1,0 +1,249 @@
+"""Global control store — cluster metadata authority.
+
+Reference analog: ``src/ray/gcs/gcs_server/`` — node table + health checks,
+actor table + FT state machine, job table, internal KV, pubsub, resource
+usage aggregation. Everything else in the cluster is rebuildable from this
+store. Here the store runs in the head process; node managers and the driver
+call it through :class:`GcsClient`, which in-process is direct calls and
+cross-process (future rounds / multi-host) the same interface over sockets —
+mirroring how Ray's ``GcsClient`` wraps gRPC accessors
+(``gcs/gcs_client/accessor.h``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from .ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    resources: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    # TPU topology annotations (mesh-aware scheduling, §7.1 of SURVEY):
+    # e.g. {"accelerator": "v5e", "slice_id": "s0", "hosts": 4, "chips": 8}.
+    topology: Dict[str, Any] = field(default_factory=dict)
+
+
+class ActorState:
+    PENDING = "PENDING_CREATION"
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: Optional[str]
+    state: str = ActorState.PENDING
+    node_id: Optional[NodeID] = None
+    worker_id: Optional[WorkerID] = None
+    num_restarts: int = 0
+    max_restarts: int = 0
+    death_cause: Optional[str] = None
+    namespace: str = "default"
+
+
+@dataclass
+class JobInfo:
+    job_id: JobID
+    entrypoint: str = ""
+    status: str = "RUNNING"  # RUNNING | SUCCEEDED | FAILED | STOPPED
+    start_time: float = field(default_factory=time.time)
+    end_time: Optional[float] = None
+    metadata: Dict[str, str] = field(default_factory=dict)
+
+
+class Pubsub:
+    """Channel-keyed pub/sub with per-subscriber callbacks.
+
+    Reference: ``src/ray/pubsub/publisher.h`` — long-poll channels for actor
+    state, node state, logs, errors. In-process this is synchronous callback
+    fan-out; the channel names mirror the reference's.
+    """
+
+    def __init__(self):
+        self._subs: Dict[str, List[Callable[[Any], None]]] = defaultdict(list)
+        self._lock = threading.RLock()
+
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> Callable[[], None]:
+        with self._lock:
+            self._subs[channel].append(callback)
+
+        def unsubscribe():
+            with self._lock:
+                try:
+                    self._subs[channel].remove(callback)
+                except ValueError:
+                    pass
+
+        return unsubscribe
+
+    def publish(self, channel: str, message: Any) -> None:
+        with self._lock:
+            subs = list(self._subs.get(channel, ()))
+        for cb in subs:
+            try:
+                cb(message)
+            except Exception:
+                pass
+
+
+class GlobalControlStore:
+    """The head-node metadata service (GcsServer equivalent)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[tuple, ActorID] = {}  # (namespace, name) -> id
+        self.jobs: Dict[JobID, JobInfo] = {}
+        self.kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)  # namespaced
+        self.placement_groups: Dict[PlacementGroupID, Any] = {}
+        self.pubsub = Pubsub()
+        self._health_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- node table (GcsNodeManager) -----------------------------------------
+    def register_node(self, info: NodeInfo) -> None:
+        with self._lock:
+            self.nodes[info.node_id] = info
+        self.pubsub.publish("NODE", ("ALIVE", info))
+
+    def heartbeat(self, node_id: NodeID) -> None:
+        with self._lock:
+            node = self.nodes.get(node_id)
+            if node is not None:
+                node.last_heartbeat = time.monotonic()
+
+    def mark_node_dead(self, node_id: NodeID, reason: str = "") -> None:
+        with self._lock:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                return
+            node.alive = False
+        self.pubsub.publish("NODE", ("DEAD", node))
+
+    def alive_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return [n for n in self.nodes.values() if n.alive]
+
+    def start_health_check(self, period_s: float, timeout_beats: int) -> None:
+        """Background failure detector (GcsHeartbeatManager equivalent)."""
+
+        def loop():
+            while not self._stop.wait(period_s):
+                deadline = time.monotonic() - period_s * timeout_beats
+                for node in list(self.nodes.values()):
+                    if node.alive and node.last_heartbeat < deadline:
+                        self.mark_node_dead(node.node_id, "heartbeat timeout")
+
+        self._health_thread = threading.Thread(target=loop, daemon=True,
+                                               name="gcs-health")
+        self._health_thread.start()
+
+    # -- actor table (GcsActorManager) ---------------------------------------
+    def register_actor(self, info: ActorInfo) -> None:
+        with self._lock:
+            self.actors[info.actor_id] = info
+            if info.name:
+                key = (info.namespace, info.name)
+                if key in self.named_actors:
+                    raise ValueError(f"Actor name {info.name!r} already taken")
+                self.named_actors[key] = info.actor_id
+
+    def update_actor(self, actor_id: ActorID, state: str,
+                     node_id: Optional[NodeID] = None,
+                     worker_id: Optional[WorkerID] = None,
+                     death_cause: Optional[str] = None) -> None:
+        with self._lock:
+            info = self.actors.get(actor_id)
+            if info is None:
+                return
+            info.state = state
+            if node_id is not None:
+                info.node_id = node_id
+            if worker_id is not None:
+                info.worker_id = worker_id
+            if death_cause is not None:
+                info.death_cause = death_cause
+            if state == ActorState.RESTARTING:
+                info.num_restarts += 1
+            if state == ActorState.DEAD and info.name:
+                self.named_actors.pop((info.namespace, info.name), None)
+        self.pubsub.publish("ACTOR", (state, actor_id))
+
+    def get_actor(self, actor_id: ActorID) -> Optional[ActorInfo]:
+        with self._lock:
+            return self.actors.get(actor_id)
+
+    def get_named_actor(self, name: str, namespace: str = "default") -> Optional[ActorInfo]:
+        with self._lock:
+            actor_id = self.named_actors.get((namespace, name))
+            return self.actors.get(actor_id) if actor_id else None
+
+    def list_actors(self) -> List[ActorInfo]:
+        with self._lock:
+            return list(self.actors.values())
+
+    # -- job table (GcsJobManager) -------------------------------------------
+    def add_job(self, info: JobInfo) -> None:
+        with self._lock:
+            self.jobs[info.job_id] = info
+
+    def finish_job(self, job_id: JobID, status: str = "SUCCEEDED") -> None:
+        with self._lock:
+            job = self.jobs.get(job_id)
+            if job:
+                job.status = status
+                job.end_time = time.time()
+
+    # -- internal KV (GcsKVManager / StoreClientKV) --------------------------
+    def kv_put(self, key: bytes, value: bytes, namespace: str = "default",
+               overwrite: bool = True) -> bool:
+        with self._lock:
+            ns = self.kv[namespace]
+            if not overwrite and key in ns:
+                return False
+            ns[key] = value
+            return True
+
+    def kv_get(self, key: bytes, namespace: str = "default") -> Optional[bytes]:
+        with self._lock:
+            return self.kv[namespace].get(key)
+
+    def kv_del(self, key: bytes, namespace: str = "default") -> bool:
+        with self._lock:
+            return self.kv[namespace].pop(key, None) is not None
+
+    def kv_keys(self, prefix: bytes = b"", namespace: str = "default") -> List[bytes]:
+        with self._lock:
+            return [k for k in self.kv[namespace] if k.startswith(prefix)]
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=2)
+
+
+class GcsClient:
+    """Typed accessor facade (reference: gcs_client/accessor.h).
+
+    In-process it's a thin pass-through; the indirection exists so that a
+    socket-backed implementation can slot in without touching callers.
+    """
+
+    def __init__(self, store: GlobalControlStore):
+        self._store = store
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)

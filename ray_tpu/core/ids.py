@@ -1,0 +1,165 @@
+"""Binary IDs for jobs, tasks, objects, actors, nodes, and placement groups.
+
+Reference analog: ``src/ray/common/id.h`` — IDs are fixed-size random byte
+strings with structure embedded (ObjectID embeds the TaskID that created it
+plus a return/put index; TaskID embeds the JobID). We keep the same layered
+encoding so lineage can be recovered from an ID alone, but sizes are smaller
+(we don't need Ray's 28-byte compatibility).
+
+Layout:
+  JobID:    4 bytes
+  ActorID:  8 bytes  = 4 unique + JobID
+  TaskID:   16 bytes = 8 unique + ActorID (or 8 unique + 4 zero + JobID)
+  ObjectID: 20 bytes = TaskID + 4-byte little-endian index
+  NodeID / WorkerID / PlacementGroupID: 16 random bytes
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_JOB_ID_SIZE = 4
+_ACTOR_ID_SIZE = 8
+_TASK_ID_SIZE = 16
+_OBJECT_ID_SIZE = 20
+_UNIQUE_ID_SIZE = 16
+
+
+class BaseID:
+    """Immutable binary identifier with hex repr."""
+
+    SIZE = _UNIQUE_ID_SIZE
+    __slots__ = ("_bytes", "_hash")
+
+    def __init__(self, id_bytes: bytes):
+        if len(id_bytes) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(id_bytes)}"
+            )
+        self._bytes = bytes(id_bytes)
+        self._hash = hash(self._bytes)
+
+    @classmethod
+    def from_random(cls) -> "BaseID":
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str) -> "BaseID":
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls) -> "BaseID":
+        return cls(b"\x00" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __lt__(self, other):
+        return self._bytes < other._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._bytes.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = _JOB_ID_SIZE
+    _counter = [0]
+    _lock = threading.Lock()
+
+    @classmethod
+    def from_int(cls, value: int) -> "JobID":
+        return cls(value.to_bytes(_JOB_ID_SIZE, "little"))
+
+    @classmethod
+    def next(cls) -> "JobID":
+        with cls._lock:
+            cls._counter[0] += 1
+            return cls.from_int(cls._counter[0])
+
+
+class ActorID(BaseID):
+    SIZE = _ACTOR_ID_SIZE
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(os.urandom(_ACTOR_ID_SIZE - _JOB_ID_SIZE) + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[-_JOB_ID_SIZE:])
+
+
+class TaskID(BaseID):
+    SIZE = _TASK_ID_SIZE
+
+    @classmethod
+    def for_task(cls, job_id: JobID) -> "TaskID":
+        unique = os.urandom(_TASK_ID_SIZE - _ACTOR_ID_SIZE)
+        filler = b"\x00" * (_ACTOR_ID_SIZE - _JOB_ID_SIZE)
+        return cls(unique + filler + job_id.binary())
+
+    @classmethod
+    def for_actor_task(cls, actor_id: ActorID) -> "TaskID":
+        unique = os.urandom(_TASK_ID_SIZE - _ACTOR_ID_SIZE)
+        return cls(unique + actor_id.binary())
+
+    @classmethod
+    def for_driver(cls, job_id: JobID) -> "TaskID":
+        filler = b"\xff" * (_TASK_ID_SIZE - _JOB_ID_SIZE)
+        return cls(filler[: _TASK_ID_SIZE - _JOB_ID_SIZE] + job_id.binary())
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._bytes[-_ACTOR_ID_SIZE:])
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[-_JOB_ID_SIZE:])
+
+
+class ObjectID(BaseID):
+    SIZE = _OBJECT_ID_SIZE
+
+    @classmethod
+    def for_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        return cls(task_id.binary() + index.to_bytes(4, "little"))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        # Put indices occupy the high half of the index space so they never
+        # collide with return indices (reference: id.h put-vs-return bit).
+        return cls(task_id.binary() + (0x8000_0000 | put_index).to_bytes(4, "little"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:_TASK_ID_SIZE])
+
+    def index(self) -> int:
+        return int.from_bytes(self._bytes[_TASK_ID_SIZE:], "little") & 0x7FFF_FFFF
+
+    def is_put(self) -> bool:
+        return bool(int.from_bytes(self._bytes[_TASK_ID_SIZE:], "little") & 0x8000_0000)
+
+
+class NodeID(BaseID):
+    SIZE = _UNIQUE_ID_SIZE
+
+
+class WorkerID(BaseID):
+    SIZE = _UNIQUE_ID_SIZE
+
+
+class PlacementGroupID(BaseID):
+    SIZE = _UNIQUE_ID_SIZE

@@ -1,0 +1,102 @@
+"""ObjectRef: a future-like handle to an owned object.
+
+Reference analog: ``python/ray/_raylet.pyx`` ObjectRef + the ownership model of
+``src/ray/core_worker/reference_count.h`` — every ref knows its owner (the
+worker whose task created the object); deserializing a ref in another worker
+registers that worker as a borrower with the owner.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Any, Optional
+
+from .ids import ObjectID
+
+
+class ObjectRef:
+    """Handle to a (possibly not-yet-materialized) object.
+
+    Local refcounting: construction/destruction notify the runtime's
+    reference counter so owned objects can be freed once all python refs,
+    pending-task refs, and borrower refs drop (reference_count.h:61).
+    """
+
+    __slots__ = ("id", "owner", "_weakref_slot", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner: Optional[bytes] = None,
+                 _register: bool = True):
+        self.id = object_id
+        self.owner = owner  # WorkerID bytes of the owner, None = local runtime
+        if _register:
+            _refcount_hook = _REFCOUNT_HOOKS.get("add")
+            if _refcount_hook is not None:
+                _refcount_hook(object_id)
+
+    def __del__(self):
+        hook = _REFCOUNT_HOOKS.get("remove")
+        if hook is not None:
+            try:
+                hook(self.id)
+            except Exception:
+                pass
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def future(self) -> Future:
+        """A concurrent.futures.Future resolved with the object's value."""
+        from .runtime import get_runtime
+
+        return get_runtime().object_future(self)
+
+    def __await__(self):
+        import asyncio
+
+        from .runtime import get_runtime
+
+        fut = get_runtime().object_future(self)
+        return asyncio.wrap_future(fut).__await__()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        # Plain pickle path (e.g. control-plane payloads). The Serializer
+        # intercepts refs before this to track borrowers.
+        return (ObjectRef._deserialize, (self.id, self.owner))
+
+    @staticmethod
+    def _deserialize(object_id: ObjectID, owner) -> "ObjectRef":
+        ref = ObjectRef(object_id, owner, _register=False)
+        hook = _REFCOUNT_HOOKS.get("borrow")
+        if hook is not None:
+            hook(object_id)
+        return ref
+
+
+# Hooks installed by the runtime's ReferenceCounter when it connects; kept as
+# a module dict so ObjectRef has no hard dependency on a live runtime.
+_REFCOUNT_HOOKS: dict = {}
+_HOOK_LOCK = threading.Lock()
+
+
+def install_refcount_hooks(add=None, remove=None, borrow=None) -> None:
+    with _HOOK_LOCK:
+        _REFCOUNT_HOOKS.clear()
+        if add:
+            _REFCOUNT_HOOKS["add"] = add
+        if remove:
+            _REFCOUNT_HOOKS["remove"] = remove
+        if borrow:
+            _REFCOUNT_HOOKS["borrow"] = borrow

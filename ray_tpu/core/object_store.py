@@ -1,0 +1,291 @@
+"""Shared-memory object store (plasma-equivalent) + in-process memory store.
+
+Reference analog:
+  - ``src/ray/object_manager/plasma/store.h`` — per-node shared-memory store of
+    immutable sealed objects, mmap'd zero-copy reads, eviction + spilling.
+  - ``src/ray/core_worker/store_provider/memory_store`` — in-process store for
+    small/inlined values.
+
+Design: one POSIX shm segment per object (``multiprocessing.shared_memory``),
+named ``rt_<object-hex>``. The creating process writes the flattened
+``SerializedObject`` frame then "seals" by publishing metadata (size, node) to
+the store directory. Readers attach by name and deserialize with zero-copy
+views into the segment. Capacity accounting + LRU-ish spill-to-disk when over
+the high-water mark (reference: ``LocalObjectManager`` spilling, raylet).
+
+The C++ arena store (``ray_tpu/_native/``) supersedes the per-object-segment
+allocator when built; this module is the always-available fallback and the
+metadata/ownership layer either way.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory
+from typing import Dict, List, Optional, Set, Tuple
+
+from .config import config
+from .exceptions import ObjectLostError, ObjectStoreFullError
+from .ids import NodeID, ObjectID
+from .serialization import SerializedObject
+
+_SEG_PREFIX = "rt_"
+
+
+def _segment_name(object_id: ObjectID) -> str:
+    return _SEG_PREFIX + object_id.hex()
+
+
+@dataclass
+class ObjectMeta:
+    object_id: ObjectID
+    size: int
+    node_id: NodeID
+    sealed: bool = True
+    spilled_path: Optional[str] = None
+    pinned: int = 0
+    last_access: float = field(default_factory=time.monotonic)
+
+
+class SharedMemoryStore:
+    """Node-local store of sealed immutable objects in POSIX shared memory.
+
+    One instance per (simulated) node lives in the node-manager process; worker
+    processes use :class:`ShmClient` to create/attach segments directly — the
+    store only tracks metadata, capacity, and spilling, like the plasma store
+    does for its clients.
+    """
+
+    def __init__(self, node_id: NodeID, capacity: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        self.node_id = node_id
+        self.capacity = capacity or config().object_store_memory
+        self.used = 0
+        self._meta: Dict[ObjectID, ObjectMeta] = {}
+        self._segments: Dict[ObjectID, shared_memory.SharedMemory] = {}
+        self._lock = threading.RLock()
+        self._spill_dir = spill_dir or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), f"rt_spill_{node_id.hex()[:8]}"
+        )
+
+    # -- create/seal ---------------------------------------------------------
+    def put_serialized(self, object_id: ObjectID, obj: SerializedObject) -> ObjectMeta:
+        frame = obj.to_bytes()
+        return self.put_bytes(object_id, frame)
+
+    def put_bytes(self, object_id: ObjectID, frame: bytes) -> ObjectMeta:
+        size = len(frame)
+        with self._lock:
+            if object_id in self._meta:
+                return self._meta[object_id]
+            self._ensure_capacity(size)
+            seg = shared_memory.SharedMemory(
+                create=True, size=max(size, 1), name=_segment_name(object_id)
+            )
+            seg.buf[:size] = frame
+            meta = ObjectMeta(object_id, size, self.node_id)
+            self._meta[object_id] = meta
+            self._segments[object_id] = seg
+            self.used += size
+            return meta
+
+    def register_external(self, object_id: ObjectID, size: int) -> ObjectMeta:
+        """Account for a segment created directly by a worker (sealed there)."""
+        with self._lock:
+            if object_id in self._meta:
+                return self._meta[object_id]
+            try:
+                seg = shared_memory.SharedMemory(name=_segment_name(object_id))
+            except FileNotFoundError:
+                raise ObjectLostError(object_id, "worker-created segment vanished")
+            meta = ObjectMeta(object_id, size, self.node_id)
+            self._meta[object_id] = meta
+            self._segments[object_id] = seg
+            self.used += size
+            return meta
+
+    # -- read ----------------------------------------------------------------
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._meta
+
+    def get_buffer(self, object_id: ObjectID) -> memoryview:
+        with self._lock:
+            meta = self._meta.get(object_id)
+            if meta is None:
+                raise ObjectLostError(object_id)
+            meta.last_access = time.monotonic()
+            if meta.spilled_path is not None:
+                self._restore(meta)
+            seg = self._segments[object_id]
+            return memoryview(seg.buf)[: meta.size]
+
+    def meta(self, object_id: ObjectID) -> Optional[ObjectMeta]:
+        with self._lock:
+            return self._meta.get(object_id)
+
+    def pin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            if object_id in self._meta:
+                self._meta[object_id].pinned += 1
+
+    def unpin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            if object_id in self._meta:
+                self._meta[object_id].pinned = max(0, self._meta[object_id].pinned - 1)
+
+    # -- delete / spill ------------------------------------------------------
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            meta = self._meta.pop(object_id, None)
+            if meta is None:
+                return
+            seg = self._segments.pop(object_id, None)
+            if seg is not None:
+                try:
+                    seg.close()
+                    seg.unlink()
+                except FileNotFoundError:
+                    pass
+                self.used -= meta.size
+            if meta.spilled_path and os.path.exists(meta.spilled_path):
+                os.unlink(meta.spilled_path)
+
+    def _ensure_capacity(self, need: int) -> None:
+        if need > self.capacity:
+            raise ObjectStoreFullError(
+                f"object of {need} bytes exceeds store capacity {self.capacity}"
+            )
+        threshold = config().object_spilling_threshold
+        if self.used + need <= self.capacity * threshold:
+            return
+        # Spill least-recently-accessed unpinned objects until there is room
+        # (reference: LocalObjectManager::SpillObjects, fused to min size).
+        candidates = sorted(
+            (m for m in self._meta.values()
+             if m.pinned == 0 and m.spilled_path is None),
+            key=lambda m: m.last_access,
+        )
+        for meta in candidates:
+            if self.used + need <= self.capacity * threshold:
+                break
+            self._spill(meta)
+        if self.used + need > self.capacity:
+            raise ObjectStoreFullError(
+                f"need {need} bytes; used {self.used}/{self.capacity} after spilling"
+            )
+
+    def _spill(self, meta: ObjectMeta) -> None:
+        os.makedirs(self._spill_dir, exist_ok=True)
+        path = os.path.join(self._spill_dir, meta.object_id.hex())
+        seg = self._segments.pop(meta.object_id)
+        with open(path, "wb") as f:
+            f.write(bytes(memoryview(seg.buf)[: meta.size]))
+        seg.close()
+        seg.unlink()
+        meta.spilled_path = path
+        self.used -= meta.size
+
+    def _restore(self, meta: ObjectMeta) -> None:
+        path = meta.spilled_path
+        assert path is not None
+        with open(path, "rb") as f:
+            frame = f.read()
+        self._ensure_capacity(len(frame))
+        seg = shared_memory.SharedMemory(
+            create=True, size=max(len(frame), 1),
+            name=_segment_name(meta.object_id),
+        )
+        seg.buf[: len(frame)] = frame
+        self._segments[meta.object_id] = seg
+        self.used += meta.size
+        meta.spilled_path = None
+        os.unlink(path)
+
+    def destroy(self) -> None:
+        """Tear down all segments (node death / shutdown)."""
+        with self._lock:
+            for oid in list(self._meta):
+                self.delete(oid)
+
+    def stats(self) -> dict:
+        with self._lock:
+            spilled = sum(1 for m in self._meta.values() if m.spilled_path)
+            return {
+                "num_objects": len(self._meta),
+                "used_bytes": self.used,
+                "capacity_bytes": self.capacity,
+                "num_spilled": spilled,
+            }
+
+
+class ShmClient:
+    """Worker-side client: create/attach segments without store round-trips.
+
+    Mirrors the plasma client: ``create`` + write + ``seal`` (here: notify the
+    owner over the worker pipe), and attach-by-name for reads. Keeps attached
+    segments open so zero-copy views stay valid for the process lifetime.
+    """
+
+    def __init__(self):
+        self._attached: Dict[str, shared_memory.SharedMemory] = {}
+        self._lock = threading.Lock()
+
+    def create_and_seal(self, object_id: ObjectID, frame: bytes) -> int:
+        seg = shared_memory.SharedMemory(
+            create=True, size=max(len(frame), 1), name=_segment_name(object_id)
+        )
+        seg.buf[: len(frame)] = frame
+        with self._lock:
+            self._attached[_segment_name(object_id)] = seg
+        return len(frame)
+
+    def read(self, object_id: ObjectID, size: int) -> memoryview:
+        name = _segment_name(object_id)
+        with self._lock:
+            seg = self._attached.get(name)
+            if seg is None:
+                seg = shared_memory.SharedMemory(name=name)
+                self._attached[name] = seg
+        return memoryview(seg.buf)[:size]
+
+    def close(self) -> None:
+        with self._lock:
+            for seg in self._attached.values():
+                try:
+                    seg.close()
+                except Exception:
+                    pass
+            self._attached.clear()
+
+
+class MemoryStore:
+    """In-process store for inlined small objects (memory_store/)."""
+
+    def __init__(self):
+        self._values: Dict[ObjectID, Tuple[bytes, tuple]] = {}
+        self._lock = threading.Lock()
+
+    def put(self, object_id: ObjectID, frame: bytes) -> None:
+        with self._lock:
+            self._values[object_id] = (frame, ())
+
+    def get(self, object_id: ObjectID) -> Optional[bytes]:
+        with self._lock:
+            entry = self._values.get(object_id)
+            return entry[0] if entry else None
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._values
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._values.pop(object_id, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._values)

@@ -1,0 +1,225 @@
+"""Placement groups: atomic multi-bundle resource reservations.
+
+Reference analog: ``python/ray/util/placement_group.py`` +
+``src/ray/gcs/gcs_server/gcs_placement_group_scheduler.h`` +
+``src/ray/raylet/scheduling/policy/bundle_scheduling_policy.h`` — a PG is a
+list of resource bundles reserved atomically across nodes under a strategy:
+
+  PACK          — prefer one node, allow spillover
+  SPREAD        — prefer distinct nodes, best-effort
+  STRICT_PACK   — all bundles on one node, else fail
+  STRICT_SPREAD — all bundles on distinct nodes, else fail
+
+TPU extension: a bundle may request ``{"TPU": k}``; mesh claims
+(``parallel.mesh.MeshClaim``) build on STRICT_PACK/SPREAD groups over hosts
+of a pod slice.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .exceptions import PlacementGroupUnschedulableError
+from .ids import NodeID, PlacementGroupID
+from .task_spec import SchedulingStrategy
+
+
+@dataclass
+class PlacementGroup:
+    id: PlacementGroupID
+    bundles: List[Dict[str, float]]
+    strategy: str = "PACK"
+    name: str = ""
+    # node chosen per bundle index once scheduled
+    bundle_nodes: List[Optional[NodeID]] = field(default_factory=list)
+    state: str = "PENDING"  # PENDING | CREATED | REMOVED | UNSCHEDULABLE
+
+    def ready(self) -> "ObjectRefLike":
+        """Returns a waitable that resolves when the PG is scheduled."""
+        from .runtime import get_head_runtime
+
+        rt = get_head_runtime()
+        return _PGReady(self, rt)
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout_seconds
+        while time.monotonic() < deadline:
+            if self.state == "CREATED":
+                return True
+            if self.state == "UNSCHEDULABLE":
+                return False
+            time.sleep(0.005)
+        return self.state == "CREATED"
+
+
+class _PGReady:
+    def __init__(self, pg: PlacementGroup, rt):
+        self._pg = pg
+
+    def result(self, timeout=None):
+        ok = self._pg.wait(timeout or 30.0)
+        if not ok:
+            raise PlacementGroupUnschedulableError(self._pg.name or
+                                                   self._pg.id.hex())
+        return self._pg
+
+
+class PlacementGroupManager:
+    """Schedules PGs over nodes (GcsPlacementGroupManager equivalent)."""
+
+    def __init__(self, runtime):
+        self._rt = runtime
+        self._groups: Dict[PlacementGroupID, PlacementGroup] = {}
+        self._lock = threading.Lock()
+
+    def create(self, bundles: List[Dict[str, float]], strategy: str = "PACK",
+               name: str = "") -> PlacementGroup:
+        if not bundles:
+            raise ValueError("placement group needs at least one bundle")
+        for b in bundles:
+            if not b or any(v < 0 for v in b.values()):
+                raise ValueError(f"invalid bundle {b}")
+        pg = PlacementGroup(PlacementGroupID.from_random(), list(bundles),
+                            strategy, name)
+        with self._lock:
+            self._groups[pg.id] = pg
+        self._try_schedule(pg)
+        self._rt.gcs.placement_groups[pg.id] = pg
+        return pg
+
+    def _try_schedule(self, pg: PlacementGroup) -> None:
+        """Reserve all bundles atomically; rollback on failure.
+
+        Reference: BundleSchedulingPolicy — sorts bundles descending by
+        demand, scores nodes; STRICT_* enforce co/anti-location.
+        """
+        nodes = [n for n in self._rt.scheduler.nodes() if n.alive]
+        order = sorted(range(len(pg.bundles)),
+                       key=lambda i: -sum(pg.bundles[i].values()))
+        assignment: List[Optional[object]] = [None] * len(pg.bundles)
+        reserved: List[tuple] = []
+
+        def rollback():
+            for node, idx in reserved:
+                node.return_bundle(pg.id, idx)
+
+        used_nodes = set()
+        ok = True
+        for idx in order:
+            bundle = pg.bundles[idx]
+            candidates = list(nodes)
+            if pg.strategy == "STRICT_PACK" and reserved:
+                candidates = [reserved[0][0]]
+            elif pg.strategy == "STRICT_SPREAD":
+                candidates = [n for n in nodes
+                              if n.node_id.binary() not in used_nodes]
+            elif pg.strategy == "PACK" and reserved:
+                candidates = sorted(
+                    candidates,
+                    key=lambda n: (n.node_id.binary() != reserved[0][0].node_id.binary()),
+                )
+            elif pg.strategy == "SPREAD":
+                candidates = sorted(
+                    candidates,
+                    key=lambda n: (n.node_id.binary() in used_nodes,
+                                   n.ledger.utilization()),
+                )
+            placed = False
+            for node in candidates:
+                if node.reserve_bundle(pg.id, idx, bundle):
+                    assignment[idx] = node
+                    reserved.append((node, idx))
+                    used_nodes.add(node.node_id.binary())
+                    placed = True
+                    break
+            if not placed:
+                ok = False
+                break
+        if not ok:
+            rollback()
+            pg.state = "UNSCHEDULABLE" if not self._feasible_later(pg) else "PENDING"
+            return
+        pg.bundle_nodes = [n.node_id for n in assignment]
+        pg.state = "CREATED"
+
+    def _feasible_later(self, pg: PlacementGroup) -> bool:
+        nodes = [n for n in self._rt.scheduler.nodes() if n.alive]
+        return any(
+            all(n.ledger.total.get(k, 0) >= v for k, v in b.items())
+            for b in pg.bundles
+            for n in nodes
+        )
+
+    def retry_pending(self) -> None:
+        with self._lock:
+            pending = [pg for pg in self._groups.values()
+                       if pg.state == "PENDING"]
+        for pg in pending:
+            self._try_schedule(pg)
+
+    def remove(self, pg: PlacementGroup) -> None:
+        with self._lock:
+            self._groups.pop(pg.id, None)
+        for idx, node_id in enumerate(pg.bundle_nodes or []):
+            if node_id is None:
+                continue
+            node = self._rt.scheduler.get_node(node_id)
+            if node is not None:
+                node.return_bundle(pg.id, idx)
+        pg.state = "REMOVED"
+        self._rt.scheduler.notify()
+
+    def get(self, pg_id: PlacementGroupID) -> Optional[PlacementGroup]:
+        with self._lock:
+            return self._groups.get(pg_id)
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str = "") -> PlacementGroup:
+    from .runtime import auto_init, get_head_runtime
+
+    auto_init()
+    rt = get_head_runtime()
+    if rt is None:
+        raise RuntimeError("placement groups must be created from the driver")
+    return rt.placement_group_manager.create(bundles, strategy, name)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    from .runtime import get_head_runtime
+
+    rt = get_head_runtime()
+    if rt is not None:
+        rt.placement_group_manager.remove(pg)
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    """Schedule a task/actor into a PG bundle (util/scheduling_strategies.py:15)."""
+
+    placement_group: PlacementGroup
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+    def to_core(self) -> SchedulingStrategy:
+        return SchedulingStrategy(
+            kind="PLACEMENT_GROUP",
+            placement_group_id=self.placement_group.id,
+            bundle_index=self.placement_group_bundle_index,
+            capture_child_tasks=self.placement_group_capture_child_tasks,
+        )
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    """Pin to a node (util/scheduling_strategies.py:41)."""
+
+    node_id: bytes
+    soft: bool = False
+
+    def to_core(self) -> SchedulingStrategy:
+        return SchedulingStrategy(kind="NODE_AFFINITY", node_id=self.node_id,
+                                  soft=self.soft)

@@ -1,0 +1,167 @@
+"""``@remote`` functions and task invocation.
+
+Reference analog: ``python/ray/remote_function.py`` — the decorator wraps a
+function into a :class:`RemoteFunction` whose ``.remote(...)`` builds a task
+spec and submits it; ``.options(...)`` returns a shallow-copied override.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+from . import serialization
+from .ids import TaskID
+from .object_ref import ObjectRef
+from .serialization import Serializer
+from .task_spec import SchedulingStrategy, TaskSpec, TaskType
+from .worker_main import _ArgSentinel
+
+_DEFAULT_OPTIONS = dict(
+    num_returns=1,
+    num_cpus=1.0,
+    num_tpus=0.0,
+    resources=None,
+    max_retries=3,
+    retry_exceptions=False,
+    scheduling_strategy=None,
+    name="",
+)
+
+
+def build_args_frame(serializer: Serializer, args, kwargs):
+    """Replace top-level ObjectRef args with positional sentinels.
+
+    Top-level refs are resolved to values before execution; refs nested in
+    structures are passed through as refs (reference semantics:
+    ``_raylet.pyx`` prepare_args). Returns (frame, arg_refs, borrowed_refs).
+    """
+    arg_refs = []
+
+    def swap(x):
+        if isinstance(x, ObjectRef):
+            arg_refs.append(x.id)
+            return _ArgSentinel(len(arg_refs) - 1)
+        return x
+
+    new_args = [swap(a) for a in args]
+    new_kwargs = {k: swap(v) for k, v in kwargs.items()}
+    serialized = serializer.serialize((new_args, new_kwargs))
+    borrowed = [r.id for r in serialized.contained_refs]
+    return serialized.to_bytes(), arg_refs, borrowed
+
+
+def resolve_strategy(opts: Dict[str, Any]) -> SchedulingStrategy:
+    strat = opts.get("scheduling_strategy")
+    if strat is None or strat == "DEFAULT":
+        return SchedulingStrategy()
+    if strat == "SPREAD":
+        return SchedulingStrategy(kind="SPREAD")
+    if isinstance(strat, SchedulingStrategy):
+        return strat
+    # Duck-typed strategy objects from util.scheduling_strategies.
+    if hasattr(strat, "to_core"):
+        return strat.to_core()
+    raise ValueError(f"bad scheduling_strategy: {strat!r}")
+
+
+def build_resources(opts: Dict[str, Any]) -> Dict[str, float]:
+    resources = dict(opts.get("resources") or {})
+    if opts.get("num_cpus"):
+        resources["CPU"] = float(opts["num_cpus"])
+    if opts.get("num_tpus"):
+        resources["TPU"] = float(opts["num_tpus"])
+    if opts.get("num_gpus"):  # accepted for API compatibility
+        resources["GPU"] = float(opts["num_gpus"])
+    return resources
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._options = dict(_DEFAULT_OPTIONS)
+        self._options.update(options or {})
+        self._fn_blob: Optional[bytes] = None
+        self._serializer = Serializer(ref_class=ObjectRef)
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._fn.__name__!r} cannot be called directly; "
+            f"use {self._fn.__name__}.remote()."
+        )
+
+    def options(self, **overrides) -> "RemoteFunction":
+        new = RemoteFunction(self._fn, {**self._options, **overrides})
+        new._fn_blob = self._fn_blob
+        return new
+
+    def _blob(self) -> bytes:
+        if self._fn_blob is None:
+            self._fn_blob = serialization.dumps(self._fn)
+        return self._fn_blob
+
+    def remote(self, *args, **kwargs):
+        from .runtime import auto_init, get_runtime
+
+        auto_init()
+        rt = get_runtime()
+        frame, arg_refs, borrowed = build_args_frame(
+            self._serializer, args, kwargs
+        )
+        opts = self._options
+        spec = TaskSpec(
+            task_id=_new_task_id(rt),
+            task_type=TaskType.NORMAL_TASK,
+            function_blob=self._blob(),
+            method_name=None,
+            args_frame=frame,
+            arg_refs=arg_refs,
+            borrowed_refs=borrowed,
+            num_returns=opts["num_returns"],
+            resources=build_resources(opts),
+            strategy=resolve_strategy(opts),
+            max_retries=opts["max_retries"],
+            retry_exceptions=opts["retry_exceptions"],
+            name=opts["name"] or self._fn.__name__,
+        )
+        refs = rt.submit_spec(spec)
+        if opts["num_returns"] == 1:
+            return refs[0]
+        if opts["num_returns"] == 0:
+            return None
+        return refs
+
+
+def _new_task_id(rt) -> TaskID:
+    if hasattr(rt, "next_task_id"):
+        return rt.next_task_id()
+    # Worker runtime: derive from its current task's job.
+    from .ids import JobID
+
+    return TaskID.for_task(JobID.from_int(1))
+
+
+def remote(*args, **kwargs):
+    """``@remote`` / ``@remote(num_cpus=..., num_returns=...)`` decorator.
+
+    Applied to a function returns a :class:`RemoteFunction`; applied to a
+    class returns an :class:`~.actor.ActorClass`.
+    """
+    from .actor import ActorClass
+
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target, {})
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("remote() takes keyword options only, e.g. "
+                        "@remote(num_cpus=2)")
+
+    def decorator(target):
+        if isinstance(target, type):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    return decorator

@@ -1,0 +1,1109 @@
+"""Head-process runtime: driver core worker + control plane composition.
+
+Reference analog: ``src/ray/core_worker/core_worker.h`` (task submission,
+object put/get/wait, reference counting, recovery) fused with the driver-side
+bootstrap of ``python/ray/_private/worker.py``. One :class:`Runtime` instance
+per driver composes:
+
+  - :class:`~.gcs.GlobalControlStore` — cluster metadata authority
+  - :class:`~.scheduler.ClusterScheduler` + per-node :class:`NodeManager`s
+  - object directory + ownership/reference counting (reference_count.h:61)
+  - task manager with lineage retention + retries (task_manager.h:105)
+  - actor manager with restart FT (gcs_actor_manager.h:214)
+  - object recovery via lineage re-execution (object_recovery_manager.h:41)
+
+Worker processes talk to it over pipes (see ``worker_main.py``); inside a
+worker the module-level API routes to the worker's own runtime adapter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import serialization
+from .config import Config, config
+from .exceptions import (
+    ActorDiedError,
+    ActorError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .gcs import ActorInfo, ActorState, GcsClient, GlobalControlStore, JobInfo
+from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from .object_ref import ObjectRef, install_refcount_hooks
+from .object_store import MemoryStore
+from .scheduler import ClusterScheduler, NodeManager, PendingLease
+from .serialization import Serializer
+from .task_spec import SchedulingStrategy, TaskSpec, TaskType
+from .worker_pool import WorkerHandle
+
+
+class _ObjStatus:
+    PENDING = "PENDING"
+    READY = "READY"
+    FAILED = "FAILED"
+    LOST = "LOST"
+
+
+@dataclass
+class _ObjectEntry:
+    status: str = _ObjStatus.PENDING
+    # location: ("memory", frame) | ("shm", node_id, size)
+    location: Optional[tuple] = None
+    error: Optional[Exception] = None
+    futures: List[Future] = field(default_factory=list)
+    waiting_tasks: List[TaskID] = field(default_factory=list)
+    creating_task: Optional[TaskID] = None
+
+
+@dataclass
+class _TaskRecord:
+    spec: TaskSpec
+    retries_left: int
+    node: Optional[NodeManager] = None
+    worker: Optional[WorkerHandle] = None
+    lease: Optional[PendingLease] = None
+    state: str = "PENDING"  # PENDING|RUNNING|DONE|FAILED|CANCELLED
+    deps_remaining: int = 0
+    resources_released: bool = False
+
+
+@dataclass
+class _ActorRecord:
+    actor_id: ActorID
+    creation_spec: TaskSpec
+    state: str = ActorState.PENDING
+    node: Optional[NodeManager] = None
+    worker: Optional[WorkerHandle] = None
+    pending: List[TaskSpec] = field(default_factory=list)
+    in_flight: Dict[bytes, TaskSpec] = field(default_factory=dict)
+    restarts_left: int = 0
+    seq: int = 0
+    methods: Dict[str, dict] = field(default_factory=dict)
+    creation_pins_released: bool = False
+
+
+class Runtime:
+    """The head runtime (driver process)."""
+
+    def __init__(self, num_cpus: Optional[float] = None,
+                 num_nodes: int = 1,
+                 resources: Optional[Dict[str, float]] = None,
+                 object_store_memory: Optional[int] = None,
+                 env: Optional[dict] = None):
+        self.job_id = JobID.next()
+        self.driver_task_id = TaskID.for_driver(self.job_id)
+        self.gcs = GlobalControlStore()
+        self.gcs_client = GcsClient(self.gcs)
+        self.scheduler = ClusterScheduler(self.gcs)
+        self.serializer = Serializer(ref_class=ObjectRef)
+        self.memory_store = MemoryStore()
+        self._lock = threading.RLock()
+        self._objects: Dict[ObjectID, _ObjectEntry] = {}
+        self._tasks: Dict[TaskID, _TaskRecord] = {}
+        self._lineage: Dict[TaskID, TaskSpec] = {}
+        self._lineage_bytes = 0
+        self._actors: Dict[ActorID, _ActorRecord] = {}
+        self._refcounts: Dict[ObjectID, int] = {}
+        self._worker_tasks: Dict[bytes, TaskID] = {}  # worker_id -> running task
+        self._blocked_workers: Dict[bytes, NodeManager] = {}
+        self._put_counter = 0
+        self._env = dict(env or {})
+        self.gcs.add_job(JobInfo(self.job_id, entrypoint="driver"))
+        from .placement_group import PlacementGroupManager
+
+        self.placement_group_manager = PlacementGroupManager(self)
+
+        import multiprocessing
+
+        ncpu = num_cpus if num_cpus is not None else multiprocessing.cpu_count()
+        node_resources = {"CPU": float(ncpu)}
+        node_resources.update(resources or {})
+        # TPU resources discovered from the local JAX client, if any.
+        node_resources.setdefault("TPU", float(_local_chip_count()))
+        for i in range(num_nodes):
+            self.add_node(node_resources, object_store_memory=object_store_memory)
+        self.scheduler.start()
+        self.gcs.start_health_check(
+            config().heartbeat_period_ms / 1000.0,
+            config().num_heartbeats_timeout,
+        )
+        install_refcount_hooks(
+            add=self._ref_added, remove=self._ref_removed, borrow=self._ref_added
+        )
+
+    # ------------------------------------------------------------------ nodes
+    def add_node(self, resources: Dict[str, float],
+                 object_store_memory: Optional[int] = None,
+                 labels: Optional[dict] = None,
+                 topology: Optional[dict] = None) -> NodeID:
+        node_id = NodeID.from_random()
+        node = NodeManager(
+            node_id, resources, self._handle_worker_message,
+            self._handle_worker_death, object_store_memory=object_store_memory,
+            env=self._env, labels=labels,
+        )
+        node.start()
+        self.scheduler.add_node(node, topology=topology)
+        if hasattr(self, "placement_group_manager"):
+            self.placement_group_manager.retry_pending()
+        return node_id
+
+    def remove_node(self, node_id: NodeID) -> None:
+        """Simulated node failure: kills its workers and destroys its store.
+
+        Objects whose only copy lived there become LOST; subsequent access
+        triggers lineage reconstruction (reference: ObjectRecoveryManager).
+        """
+        node = self.scheduler.remove_node(node_id)
+        if node is None:
+            return
+        with self._lock:
+            for oid, entry in self._objects.items():
+                if (
+                    entry.status == _ObjStatus.READY
+                    and entry.location
+                    and entry.location[0] == "shm"
+                    and entry.location[1] == node_id
+                ):
+                    entry.status = _ObjStatus.LOST
+                    entry.location = None
+        node.shutdown()
+        self.scheduler.notify()
+
+    # ------------------------------------------------------- refcounting
+    def _ref_added(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._refcounts[oid] = self._refcounts.get(oid, 0) + 1
+
+    def _ref_removed(self, oid: ObjectID) -> None:
+        free = False
+        with self._lock:
+            n = self._refcounts.get(oid, 0) - 1
+            if n <= 0:
+                self._refcounts.pop(oid, None)
+                entry = self._objects.get(oid)
+                if entry is not None and not entry.waiting_tasks and not entry.futures:
+                    free = entry.status in (_ObjStatus.READY, _ObjStatus.FAILED)
+            else:
+                self._refcounts[oid] = n
+        if free:
+            self._free_object(oid)
+
+    def _free_object(self, oid: ObjectID) -> None:
+        with self._lock:
+            entry = self._objects.pop(oid, None)
+        if entry is None:
+            return
+        self.memory_store.delete(oid)
+        if entry.location and entry.location[0] == "shm":
+            node = self.scheduler.get_node(entry.location[1])
+            if node is not None:
+                node.store.delete(oid)
+
+    # ------------------------------------------------------------------- put
+    def put(self, value: Any) -> ObjectRef:
+        with self._lock:
+            self._put_counter += 1
+            oid = ObjectID.for_put(self.driver_task_id, self._put_counter)
+        serialized = self.serializer.serialize(value)
+        frame = serialized.to_bytes()
+        self._store_frame(oid, frame)
+        return ObjectRef(oid)
+
+    def _store_frame(self, oid: ObjectID, frame: bytes,
+                     node: Optional[NodeManager] = None) -> None:
+        if len(frame) <= config().max_direct_call_object_size:
+            self.memory_store.put(oid, frame)
+            location = ("memory",)
+        else:
+            node = node or self.scheduler.nodes()[0]
+            node.store.put_bytes(oid, frame)
+            location = ("shm", node.node_id, len(frame))
+        self._mark_ready(oid, location)
+
+    def _mark_ready(self, oid: ObjectID, location: tuple) -> None:
+        with self._lock:
+            entry = self._objects.setdefault(oid, _ObjectEntry())
+            entry.status = _ObjStatus.READY
+            entry.location = location
+            entry.error = None
+            futures = entry.futures
+            entry.futures = []
+            waiting = entry.waiting_tasks
+            entry.waiting_tasks = []
+        for fut in futures:
+            try:
+                fut.set_result(self._materialize_value(oid))
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+        for task_id in waiting:
+            self._dep_ready(task_id)
+
+    def _mark_failed(self, oid: ObjectID, error: Exception) -> None:
+        with self._lock:
+            entry = self._objects.setdefault(oid, _ObjectEntry())
+            entry.status = _ObjStatus.FAILED
+            entry.error = error
+            futures = entry.futures
+            entry.futures = []
+            waiting = entry.waiting_tasks
+            entry.waiting_tasks = []
+        for fut in futures:
+            fut.set_exception(error)
+        for task_id in waiting:
+            self._dep_ready(task_id)
+
+    # ------------------------------------------------------------------- get
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        futures = [self.object_future(r) for r in ref_list]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        values = []
+        for fut in futures:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            try:
+                values.append(fut.result(timeout=remaining))
+            except TimeoutError:
+                raise GetTimeoutError(
+                    f"get() timed out after {timeout}s waiting for objects"
+                ) from None
+        return values[0] if single else values
+
+    def object_future(self, ref: ObjectRef) -> Future:
+        fut: Future = Future()
+        recover = False
+        with self._lock:
+            entry = self._objects.get(ref.id)
+            if entry is None:
+                entry = self._objects.setdefault(ref.id, _ObjectEntry())
+            if entry.status == _ObjStatus.READY:
+                try:
+                    fut.set_result(self._materialize_value(ref.id))
+                except ObjectLostError:
+                    entry.status = _ObjStatus.LOST
+                    entry.location = None
+                    fut = Future()
+                    entry.futures.append(fut)
+                    recover = True
+            elif entry.status == _ObjStatus.FAILED:
+                fut.set_exception(entry.error)
+            elif entry.status == _ObjStatus.LOST:
+                entry.futures.append(fut)
+                recover = True
+            else:
+                entry.futures.append(fut)
+        if recover:
+            self._recover_object(ref.id)
+        return fut
+
+    def _materialize_value(self, oid: ObjectID):
+        entry = self._objects[oid]
+        if entry.location[0] == "memory":
+            frame = self.memory_store.get(oid)
+            if frame is None:
+                raise ObjectLostError(oid)
+            return self.serializer.deserialize(frame)
+        _, node_id, size = entry.location
+        node = self.scheduler.get_node(node_id)
+        if node is None:
+            raise ObjectLostError(oid, f"node {node_id.hex()[:8]} holding object is gone")
+        buf = node.store.get_buffer(oid)
+        # Copy out of shm on the driver: values outlive store eviction.
+        return self.serializer.deserialize(bytes(buf))
+
+    def _object_entry_payload(self, oid: ObjectID):
+        """Entry for shipping to a worker: inline frame or shm pointer."""
+        entry = self._objects.get(oid)
+        if entry is None or entry.status != _ObjStatus.READY:
+            if entry is not None and entry.status == _ObjStatus.FAILED:
+                return ("error", entry.error)
+            return None
+        if entry.location[0] == "memory":
+            return ("inline", self.memory_store.get(oid))
+        _, node_id, size = entry.location
+        return ("shm", (oid.binary(), size))
+
+    # ------------------------------------------------------------------ wait
+    def wait(self, refs: List[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True):
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds number of refs")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        cond = threading.Condition()
+        done: set = set()
+
+        def check() -> bool:
+            with self._lock:
+                for r in refs:
+                    e = self._objects.get(r.id)
+                    if e is not None and e.status in (_ObjStatus.READY, _ObjStatus.FAILED):
+                        done.add(r.id)
+            return len(done) >= num_returns
+
+        while not check():
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.002)
+        ready = [r for r in refs if r.id in done][: max(num_returns, len(done))]
+        ready_ids = {r.id for r in ready}
+        not_ready = [r for r in refs if r.id not in ready_ids]
+        return ready, not_ready
+
+    # ------------------------------------------------------ task submission
+    def submit_spec(self, spec: TaskSpec) -> List[ObjectRef]:
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            return self._create_actor(spec)
+        if spec.task_type == TaskType.ACTOR_TASK:
+            return self._submit_actor_task(spec)
+        return self._submit_normal_task(spec)
+
+    def _submit_normal_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        record = _TaskRecord(spec, retries_left=spec.max_retries)
+        return_refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        with self._lock:
+            self._tasks[spec.task_id] = record
+            self._retain_lineage(spec)
+            for oid in spec.return_ids():
+                entry = self._objects.setdefault(oid, _ObjectEntry())
+                entry.creating_task = spec.task_id
+        self._increment_arg_pins(spec)
+        self._schedule_task(record)
+        return return_refs
+
+    def _retain_lineage(self, spec: TaskSpec) -> None:
+        size = len(spec.args_frame) + len(spec.function_blob or b"")
+        if self._lineage_bytes + size > config().max_lineage_bytes:
+            return  # over cap: objects from this task won't be reconstructible
+        self._lineage[spec.task_id] = spec
+        self._lineage_bytes += size
+
+    def _schedule_task(self, record: _TaskRecord) -> None:
+        spec = record.spec
+        lease = PendingLease(
+            spec,
+            on_granted=lambda node, worker: self._dispatch(record, node, worker),
+            on_unschedulable=lambda msg: self._fail_task(
+                record, TaskError(RuntimeError(msg), task_desc=spec.describe())
+            ),
+        )
+        record.lease = lease
+        pending_deps = 0
+        with self._lock:
+            for oid in spec.arg_refs:
+                entry = self._objects.setdefault(oid, _ObjectEntry())
+                if entry.status == _ObjStatus.PENDING:
+                    entry.waiting_tasks.append(spec.task_id)
+                    pending_deps += 1
+                elif entry.status == _ObjStatus.LOST:
+                    entry.waiting_tasks.append(spec.task_id)
+                    pending_deps += 1
+                    self._recover_object(oid)
+            record.deps_remaining = pending_deps
+            lease.deps_ready = pending_deps == 0
+        self.scheduler.submit(lease)
+
+    def _dep_ready(self, task_id: TaskID) -> None:
+        with self._lock:
+            record = self._tasks.get(task_id)
+            if record is None or record.lease is None:
+                return
+            record.deps_remaining -= 1
+            if record.deps_remaining <= 0:
+                record.lease.deps_ready = True
+        self.scheduler.notify()
+
+    def _dispatch(self, record: _TaskRecord, node: NodeManager,
+                  worker: WorkerHandle) -> None:
+        spec = record.spec
+        resolved: Dict[int, Any] = {}
+        failed_error = None
+        with self._lock:
+            for i, oid in enumerate(spec.arg_refs):
+                payload = self._object_entry_payload(oid)
+                if payload is None:
+                    failed_error = ObjectLostError(oid, "arg unavailable at dispatch")
+                    break
+                if payload[0] == "error":
+                    failed_error = payload[1]
+                    break
+                resolved[i] = payload
+            record.node = node
+            record.worker = worker
+            record.state = "RUNNING"
+            self._worker_tasks[worker.worker_id.binary()] = spec.task_id
+        if failed_error is not None:
+            node.pool.return_worker(worker)
+            self.scheduler.release(node, spec)
+            self._fail_task(record, failed_error, retryable=False)
+            return
+        ok = worker.send(("exec", spec.task_id.hex(), {
+            "task_type": spec.task_type.value,
+            "function_blob": spec.function_blob,
+            "method_name": spec.method_name,
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            "args_frame": spec.args_frame,
+            "resolved_args": resolved,
+            "num_returns": spec.num_returns,
+            "max_concurrency": spec.max_concurrency,
+            "name": spec.describe(),
+        }))
+        if not ok:
+            self._handle_worker_death(worker)
+
+    # ------------------------------------------------ completions & failures
+    def _complete_task(self, record: _TaskRecord, results: List[tuple]) -> None:
+        spec = record.spec
+        with self._lock:
+            record.state = "DONE"
+            self._worker_tasks.pop(
+                record.worker.worker_id.binary() if record.worker else b"", None
+            )
+        for i, (kind, payload) in enumerate(results):
+            oid = ObjectID.for_return(spec.task_id, i)
+            if kind == "inline":
+                self.memory_store.put(oid, payload)
+                self._mark_ready(oid, ("memory",))
+            else:  # shm, sealed by the worker on its node
+                size = payload
+                record.node.store.register_external(oid, size)
+                self._mark_ready(oid, ("shm", record.node.node_id, size))
+        self._release_after_task(record)
+        self._decrement_arg_pins(spec)
+        self.placement_group_manager.retry_pending()
+
+    def _release_after_task(self, record: _TaskRecord) -> None:
+        node, worker, spec = record.node, record.worker, record.spec
+        if node is not None and worker is not None:
+            if spec.task_type != TaskType.ACTOR_TASK:
+                node.pool.return_worker(worker)
+                if not record.resources_released:
+                    self.scheduler.release(node, spec)
+
+    def _decrement_arg_pins(self, spec: TaskSpec) -> None:
+        for oid in list(spec.arg_refs) + list(spec.borrowed_refs):
+            self._ref_removed(oid)
+
+    def _increment_arg_pins(self, spec: TaskSpec) -> None:
+        for oid in list(spec.arg_refs) + list(spec.borrowed_refs):
+            self._ref_added(oid)
+
+    def _fail_task(self, record: _TaskRecord, error: Exception,
+                   retryable: bool = True) -> None:
+        spec = record.spec
+        retry = retryable and record.retries_left > 0 and (
+            isinstance(error, (WorkerCrashedError, ObjectLostError))
+            or spec.retry_exceptions
+        )
+        with self._lock:
+            if record.worker is not None:
+                self._worker_tasks.pop(record.worker.worker_id.binary(), None)
+        if record.node is not None:
+            self._release_after_task(record)
+        if retry:
+            record.retries_left -= 1
+            record.node = record.worker = None
+            record.state = "PENDING"
+            self._schedule_task(record)
+            return
+        record.state = "FAILED"
+        for oid in spec.return_ids():
+            self._mark_failed(oid, error)
+        self._decrement_arg_pins(spec)
+
+    # ------------------------------------------------------------- recovery
+    def _recover_object(self, oid: ObjectID) -> None:
+        """Lineage reconstruction: resubmit the creating task.
+
+        Reference: ObjectRecoveryManager — try another copy (none on a single
+        host), then restore from spill (store handles transparently), then
+        resubmit the producer from retained lineage, recursively recovering
+        its lost args.
+        """
+        with self._lock:
+            entry = self._objects.get(oid)
+            if entry is None:
+                return
+            task_id = entry.creating_task or oid.task_id()
+            spec = self._lineage.get(task_id)
+            existing = self._tasks.get(task_id)
+            if existing is not None and existing.state in ("PENDING", "RUNNING"):
+                return  # already being recomputed
+            if spec is None:
+                self._mark_failed_locked = True
+        if spec is None:
+            self._mark_failed(
+                oid, ObjectLostError(oid, "no lineage retained to reconstruct")
+            )
+            return
+        record = _TaskRecord(spec, retries_left=spec.max_retries)
+        with self._lock:
+            self._tasks[task_id] = record
+            self._increment_arg_pins(spec)
+            for rid in spec.return_ids():
+                e = self._objects.setdefault(rid, _ObjectEntry())
+                e.status = _ObjStatus.PENDING
+                e.creating_task = task_id
+        self._schedule_task(record)
+
+    # --------------------------------------------------------------- actors
+    def _create_actor(self, spec: TaskSpec) -> List[ObjectRef]:
+        actor_id = spec.actor_id
+        record = _ActorRecord(
+            actor_id, spec, restarts_left=spec.max_restarts,
+        )
+        with self._lock:
+            self._actors[actor_id] = record
+        self.gcs.register_actor(ActorInfo(
+            actor_id, spec.name or None, max_restarts=spec.max_restarts,
+        ))
+        self._increment_arg_pins(spec)
+        self._schedule_actor_creation(record)
+        return [ObjectRef(oid) for oid in spec.return_ids()]
+
+    def _schedule_actor_creation(self, record: _ActorRecord) -> None:
+        spec = record.creation_spec
+        task_record = _TaskRecord(spec, retries_left=0)
+        with self._lock:
+            self._tasks[spec.task_id] = task_record
+
+        def on_granted(node: NodeManager, worker: WorkerHandle):
+            node.pool.dedicate(worker, record.actor_id)
+            with self._lock:
+                record.node = node
+                record.worker = worker
+            self._dispatch(task_record, node, worker)
+
+        lease = PendingLease(
+            spec, on_granted=on_granted,
+            on_unschedulable=lambda msg: self._actor_creation_failed(
+                record, ActorError(record.actor_id, msg)
+            ),
+        )
+        task_record.lease = lease
+        pending = 0
+        with self._lock:
+            for oid in spec.arg_refs:
+                entry = self._objects.setdefault(oid, _ObjectEntry())
+                if entry.status in (_ObjStatus.PENDING, _ObjStatus.LOST):
+                    entry.waiting_tasks.append(spec.task_id)
+                    pending += 1
+                    if entry.status == _ObjStatus.LOST:
+                        self._recover_object(oid)
+            task_record.deps_remaining = pending
+            lease.deps_ready = pending == 0
+        self.scheduler.submit(lease)
+
+    def _actor_creation_done(self, record: _ActorRecord) -> None:
+        with self._lock:
+            record.state = ActorState.ALIVE
+            pending = list(record.pending)
+            record.pending = []
+        self.gcs.update_actor(record.actor_id, ActorState.ALIVE,
+                              node_id=record.node.node_id,
+                              worker_id=record.worker.worker_id)
+        for spec in pending:
+            self._push_actor_task(record, spec)
+
+    def _actor_creation_failed(self, record: _ActorRecord, error: Exception) -> None:
+        with self._lock:
+            record.state = ActorState.DEAD
+            pending = list(record.pending)
+            record.pending = []
+            in_flight = list(record.in_flight.values())
+            record.in_flight = {}
+            worker = record.worker
+        if worker is not None:
+            worker.kill()  # ctor failed: reap the dedicated worker
+        self.gcs.update_actor(record.actor_id, ActorState.DEAD,
+                              death_cause=str(error))
+        for oid in record.creation_spec.return_ids():
+            self._mark_failed(oid, error)
+        for spec in pending + in_flight:
+            for oid in spec.return_ids():
+                self._mark_failed(oid, ActorDiedError(record.actor_id, str(error)))
+
+    def _submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        with self._lock:
+            record = self._actors.get(spec.actor_id)
+            if record is None:
+                raise ActorError(spec.actor_id, "unknown actor")
+            record.seq += 1
+            spec.actor_seq_no = record.seq
+            refs = [ObjectRef(oid) for oid in spec.return_ids()]
+            for oid in spec.return_ids():
+                entry = self._objects.setdefault(oid, _ObjectEntry())
+                entry.creating_task = spec.task_id
+            if record.state == ActorState.DEAD:
+                err = ActorDiedError(
+                    spec.actor_id,
+                    f"Actor is dead: "
+                    f"{self.gcs.get_actor(spec.actor_id).death_cause}",
+                )
+                for oid in spec.return_ids():
+                    self._mark_failed(oid, err)
+                return refs
+            if record.state in (ActorState.PENDING, ActorState.RESTARTING):
+                self._increment_arg_pins(spec)
+                record.pending.append(spec)
+                return refs
+        self._increment_arg_pins(spec)
+        self._push_actor_task(record, spec)
+        return refs
+
+    def _push_actor_task(self, record: _ActorRecord, spec: TaskSpec) -> None:
+        with self._lock:
+            record.in_flight[spec.task_id.binary()] = spec
+            task_record = _TaskRecord(spec, retries_left=spec.max_retries,
+                                      node=record.node, worker=record.worker,
+                                      state="RUNNING")
+            self._tasks[spec.task_id] = task_record
+            self._worker_tasks[record.worker.worker_id.binary()] = spec.task_id
+        resolved: Dict[int, Any] = {}
+        failed = None
+        with self._lock:
+            for i, oid in enumerate(spec.arg_refs):
+                payload = self._object_entry_payload(oid)
+                if payload is None or payload[0] == "error":
+                    failed = (payload[1] if payload else
+                              ObjectLostError(oid, "actor-task arg unavailable"))
+                    break
+                resolved[i] = payload
+        if failed is not None:
+            with self._lock:
+                record.in_flight.pop(spec.task_id.binary(), None)
+            for oid in spec.return_ids():
+                self._mark_failed(oid, failed)
+            return
+        ok = record.worker.send(("exec", spec.task_id.hex(), {
+            "task_type": spec.task_type.value,
+            "function_blob": None,
+            "method_name": spec.method_name,
+            "actor_id": spec.actor_id.hex(),
+            "args_frame": spec.args_frame,
+            "resolved_args": resolved,
+            "num_returns": spec.num_returns,
+            "name": spec.describe(),
+        }))
+        if not ok:
+            self._handle_worker_death(record.worker)
+
+    def terminate_actor(self, actor_id: ActorID) -> None:
+        """Graceful termination: drain queued methods, then exit the worker.
+
+        Triggered when the owning handle goes out of scope (reference:
+        actor handle refcount drop -> __ray_terminate__).
+        """
+        with self._lock:
+            record = self._actors.get(actor_id)
+            if record is None or record.state == ActorState.DEAD:
+                return
+            record.state = ActorState.DEAD
+            record.restarts_left = 0
+            pending = list(record.pending)
+            record.pending = []
+            worker = record.worker
+        self.gcs.update_actor(actor_id, ActorState.DEAD,
+                              death_cause="all handles out of scope")
+        for spec in pending:
+            for oid in spec.return_ids():
+                self._mark_failed(oid, ActorDiedError(
+                    actor_id, "actor terminated (handle out of scope)"))
+        if worker is not None:
+            worker.send(("drain_exit",))
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        with self._lock:
+            record = self._actors.get(actor_id)
+            if record is None:
+                return
+            if no_restart:
+                record.restarts_left = 0
+            worker = record.worker
+        if worker is not None:
+            # kill() marks the handle DEAD, which suppresses the pump
+            # thread's death callback — run the FT path synchronously so
+            # in-flight and subsequent calls fail deterministically.
+            worker.kill()
+            self._handle_worker_death(worker)
+        else:
+            self._handle_actor_death(record)
+
+    def get_actor_record(self, actor_id: ActorID) -> Optional[_ActorRecord]:
+        with self._lock:
+            return self._actors.get(actor_id)
+
+    # ---------------------------------------------------- worker messages
+    def _handle_worker_message(self, worker: WorkerHandle, msg: tuple) -> None:
+        kind = msg[0]
+        if kind == "register":
+            return
+        if kind == "done":
+            _, task_id_hex, results = msg
+            task_id = TaskID.from_hex(task_id_hex)
+            with self._lock:
+                record = self._tasks.get(task_id)
+            if record is None:
+                return
+            if record.spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                actor = self._actors.get(record.spec.actor_id)
+                with self._lock:
+                    record.state = "DONE"
+                if actor is not None:
+                    self._actor_creation_done(actor)
+                    if not actor.creation_pins_released:
+                        actor.creation_pins_released = True
+                        self._decrement_arg_pins(record.spec)
+                self._mark_ready_creation_returns(record, results)
+            elif record.spec.task_type == TaskType.ACTOR_TASK:
+                actor = self._actors.get(record.spec.actor_id)
+                if actor is not None:
+                    with self._lock:
+                        actor.in_flight.pop(task_id.binary(), None)
+                self._complete_actor_task(record, results)
+            else:
+                self._complete_task(record, results)
+            self.scheduler.notify()
+        elif kind == "error":
+            _, task_id_hex, err_blob, retryable = msg
+            task_id = TaskID.from_hex(task_id_hex)
+            error = serialization.loads(err_blob)
+            with self._lock:
+                record = self._tasks.get(task_id)
+            if record is None:
+                return
+            if record.spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                actor = self._actors.get(record.spec.actor_id)
+                if actor is not None:
+                    self._actor_creation_failed(actor, error)
+            elif record.spec.task_type == TaskType.ACTOR_TASK:
+                actor = self._actors.get(record.spec.actor_id)
+                if actor is not None:
+                    with self._lock:
+                        actor.in_flight.pop(task_id.binary(), None)
+                record.state = "FAILED"
+                for oid in record.spec.return_ids():
+                    self._mark_failed(oid, error)
+            else:
+                # App-level exception: only retried with retry_exceptions.
+                self._fail_task(record, error,
+                                retryable=record.spec.retry_exceptions)
+            self.scheduler.notify()
+        elif kind in ("get", "wait", "put", "submit", "kill_actor", "cancel",
+                      "get_actor"):
+            threading.Thread(
+                target=self._handle_worker_rpc, args=(worker, msg), daemon=True
+            ).start()
+
+    def _mark_ready_creation_returns(self, record: _TaskRecord, results) -> None:
+        for i, (kind, payload) in enumerate(results):
+            oid = ObjectID.for_return(record.spec.task_id, i)
+            if kind == "inline":
+                self.memory_store.put(oid, payload)
+                self._mark_ready(oid, ("memory",))
+
+    def _complete_actor_task(self, record: _TaskRecord, results) -> None:
+        spec = record.spec
+        with self._lock:
+            record.state = "DONE"
+        for i, (kind, payload) in enumerate(results):
+            oid = ObjectID.for_return(spec.task_id, i)
+            if kind == "inline":
+                self.memory_store.put(oid, payload)
+                self._mark_ready(oid, ("memory",))
+            else:
+                size = payload
+                record.node.store.register_external(oid, size)
+                self._mark_ready(oid, ("shm", record.node.node_id, size))
+        self._decrement_arg_pins(spec)
+
+    def _handle_worker_rpc(self, worker: WorkerHandle, msg: tuple) -> None:
+        kind, req_id = msg[0], msg[1]
+        try:
+            if kind == "get":
+                _, _, id_bins, timeout = msg
+                refs = [ObjectRef(ObjectID(b), _register=False) for b in id_bins]
+                self._mark_worker_blocked(worker)
+                try:
+                    futures = [self.object_future(r) for r in refs]
+                    deadline = (None if timeout is None
+                                else time.monotonic() + timeout)
+                    entries = []
+                    for r, fut in zip(refs, futures):
+                        remaining = (None if deadline is None
+                                     else max(0.0, deadline - time.monotonic()))
+                        try:
+                            fut.result(timeout=remaining)
+                            with self._lock:
+                                entries.append(self._object_entry_payload(r.id))
+                        except TimeoutError:
+                            raise GetTimeoutError("get() timed out") from None
+                        except Exception as e:  # noqa: BLE001
+                            entries.append(("error", e))
+                    worker.send(("reply", req_id, True, entries))
+                finally:
+                    self._mark_worker_unblocked(worker)
+            elif kind == "wait":
+                _, _, id_bins, num_returns, timeout = msg
+                refs = [ObjectRef(ObjectID(b), _register=False) for b in id_bins]
+                self._mark_worker_blocked(worker)
+                try:
+                    ready, _ = self.wait(refs, num_returns, timeout)
+                finally:
+                    self._mark_worker_unblocked(worker)
+                worker.send(("reply", req_id, True, [r.id.binary() for r in ready]))
+            elif kind == "put":
+                _, _, oid_bin, entry = msg
+                oid = ObjectID(oid_bin)
+                if entry[0] == "inline":
+                    self.memory_store.put(oid, entry[1])
+                    self._mark_ready(oid, ("memory",))
+                else:
+                    size = entry[1]
+                    node = self._node_of_worker(worker)
+                    node.store.register_external(oid, size)
+                    self._mark_ready(oid, ("shm", node.node_id, size))
+                self._ref_added(oid)
+                worker.send(("reply", req_id, True, oid_bin))
+            elif kind == "submit":
+                _, _, spec_blob = msg
+                spec = serialization.loads(spec_blob)
+                refs = self.submit_spec(spec)
+                worker.send(("reply", req_id, True,
+                             [r.id.binary() for r in refs]))
+            elif kind == "kill_actor":
+                _, _, actor_bin, no_restart = msg
+                self.kill_actor(ActorID(actor_bin), no_restart)
+                worker.send(("reply", req_id, True, None))
+            elif kind == "cancel":
+                _, _, oid_bin, force = msg
+                self.cancel(ObjectRef(ObjectID(oid_bin), _register=False), force)
+                worker.send(("reply", req_id, True, None))
+            elif kind == "get_actor":
+                _, _, name, namespace = msg
+                info = self.gcs.get_named_actor(name, namespace)
+                payload = None
+                if info is not None:
+                    blob = self.gcs.kv_get(
+                        b"actor_handle:" + info.actor_id.binary(), "actors"
+                    )
+                    payload = blob
+                worker.send(("reply", req_id, True, payload))
+        except Exception as e:  # noqa: BLE001
+            try:
+                worker.send(("reply", req_id, False, e))
+            except Exception:
+                pass
+
+    def _node_of_worker(self, worker: WorkerHandle) -> NodeManager:
+        node = self.scheduler.get_node(worker.node_id)
+        if node is None:
+            raise ObjectLostError(None, "worker's node is gone")
+        return node
+
+    def _mark_worker_blocked(self, worker: WorkerHandle) -> None:
+        """Release CPU + pool slot while a worker blocks in get/wait.
+
+        Reference: core worker notifies the raylet it is blocked so the CPU
+        is released and the pool can start another worker, avoiding deadlock
+        when nested tasks wait on their children.
+        """
+        with self._lock:
+            task_id = self._worker_tasks.get(worker.worker_id.binary())
+            record = self._tasks.get(task_id) if task_id else None
+            node = self.scheduler.get_node(worker.node_id)
+            if record is not None and node is not None and not record.resources_released:
+                record.resources_released = True
+                if record.spec.strategy.kind != "PLACEMENT_GROUP":
+                    node.ledger.release(record.spec.resources)
+                node.pool.grow(1)
+                self._blocked_workers[worker.worker_id.binary()] = node
+        self.scheduler.notify()
+
+    def _mark_worker_unblocked(self, worker: WorkerHandle) -> None:
+        with self._lock:
+            node = self._blocked_workers.pop(worker.worker_id.binary(), None)
+            if node is not None:
+                node.pool.size = max(1, node.pool.size - 1)
+
+    # ------------------------------------------------------- worker death
+    def _handle_worker_death(self, worker: WorkerHandle) -> None:
+        with self._lock:
+            task_id = self._worker_tasks.pop(worker.worker_id.binary(), None)
+            record = self._tasks.get(task_id) if task_id else None
+            actor_record = None
+            if worker.actor_id is not None:
+                actor_record = self._actors.get(worker.actor_id)
+        node = self.scheduler.get_node(worker.node_id)
+        if node is not None and node.alive:
+            worker.state = WorkerHandle.DEAD
+        if actor_record is not None:
+            self._handle_actor_death(actor_record)
+            return
+        if record is not None and record.state == "RUNNING":
+            self._fail_task(record, WorkerCrashedError(
+                f"worker executing {record.spec.describe()} died"))
+        self.scheduler.notify()
+
+    def _handle_actor_death(self, record: _ActorRecord) -> None:
+        with self._lock:
+            if record.state == ActorState.DEAD:
+                return
+            in_flight = list(record.in_flight.values())
+            record.in_flight = {}
+            can_restart = record.restarts_left != 0
+            if can_restart:
+                if record.restarts_left > 0:
+                    record.restarts_left -= 1
+                record.state = ActorState.RESTARTING
+                # In-flight methods are failed (at-most-once default, like
+                # the reference; max_task_retries replay is opt-in per task).
+                for spec in in_flight:
+                    if spec.max_retries > 0:
+                        record.pending.insert(0, spec)
+            else:
+                record.state = ActorState.DEAD
+        if record.state == ActorState.RESTARTING:
+            self.gcs.update_actor(record.actor_id, ActorState.RESTARTING)
+            for spec in in_flight:
+                if spec.max_retries <= 0:
+                    for oid in spec.return_ids():
+                        self._mark_failed(oid, ActorDiedError(
+                            record.actor_id, "actor died; method not retried"))
+            self._schedule_actor_creation(record)
+        else:
+            self.gcs.update_actor(record.actor_id, ActorState.DEAD,
+                                  death_cause="worker died")
+            with self._lock:
+                pending = list(record.pending)
+                record.pending = []
+            for spec in in_flight + pending:
+                for oid in spec.return_ids():
+                    self._mark_failed(oid, ActorDiedError(record.actor_id))
+        self.scheduler.notify()
+
+    # ------------------------------------------------------------ cancel
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        task_id = ref.id.task_id()
+        with self._lock:
+            record = self._tasks.get(task_id)
+        if record is None:
+            return
+        if record.state == "PENDING":
+            record.state = "CANCELLED"
+            if record.lease is not None:
+                with self.scheduler._lock:
+                    if record.lease in self.scheduler._queue:
+                        self.scheduler._queue.remove(record.lease)
+            for oid in record.spec.return_ids():
+                self._mark_failed(oid, TaskCancelledError(
+                    f"task {record.spec.describe()} cancelled"))
+        elif record.state == "RUNNING" and force and record.worker is not None:
+            record.worker.kill()
+
+    # ------------------------------------------------------------- info
+    def cluster_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for node in self.scheduler.nodes():
+            for k, v in node.ledger.total.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def available_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for node in self.scheduler.nodes():
+            for k, v in node.ledger.available.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def next_task_id(self) -> TaskID:
+        return TaskID.for_task(self.job_id)
+
+    def next_actor_id(self) -> ActorID:
+        return ActorID.of(self.job_id)
+
+    # ---------------------------------------------------------- shutdown
+    def shutdown(self) -> None:
+        self.gcs.finish_job(self.job_id)
+        install_refcount_hooks()
+        self.scheduler.shutdown()
+        self.gcs.shutdown()
+
+
+def _local_chip_count() -> int:
+    try:
+        import jax
+
+        return len([d for d in jax.devices() if d.platform != "cpu"])
+    except Exception:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# Module-level current-runtime dispatch (driver Runtime or worker adapter).
+# ---------------------------------------------------------------------------
+
+_runtime: Optional[Runtime] = None
+_worker_runtime = None
+_init_lock = threading.Lock()
+
+
+def init(num_cpus: Optional[float] = None, num_nodes: int = 1,
+         resources: Optional[Dict[str, float]] = None,
+         object_store_memory: Optional[int] = None,
+         ignore_reinit_error: bool = False,
+         env: Optional[dict] = None, **kwargs) -> Runtime:
+    global _runtime
+    with _init_lock:
+        if _runtime is not None:
+            if ignore_reinit_error:
+                return _runtime
+            raise RuntimeError("runtime already initialized; "
+                               "pass ignore_reinit_error=True to reuse")
+        _runtime = Runtime(num_cpus=num_cpus, num_nodes=num_nodes,
+                           resources=resources,
+                           object_store_memory=object_store_memory, env=env)
+        return _runtime
+
+
+def shutdown() -> None:
+    global _runtime
+    with _init_lock:
+        if _runtime is not None:
+            _runtime.shutdown()
+            _runtime = None
+
+
+def is_initialized() -> bool:
+    return _runtime is not None or _worker_runtime is not None
+
+
+def get_runtime():
+    """The runtime backing the public API in this process."""
+    if _worker_runtime is not None:
+        return _worker_runtime
+    if _runtime is None:
+        init()
+    return _runtime
+
+
+def get_head_runtime() -> Optional[Runtime]:
+    return _runtime
+
+
+def _set_worker_mode(worker_runtime) -> None:
+    global _worker_runtime
+    _worker_runtime = worker_runtime
+
+
+def auto_init() -> None:
+    if not is_initialized():
+        init()

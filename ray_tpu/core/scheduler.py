@@ -1,0 +1,320 @@
+"""Two-level lease-based scheduler.
+
+Reference analog:
+  - ``src/ray/raylet/scheduling/cluster_task_manager.h`` — picks a node for
+    each queued lease request (spillback when the best node is remote).
+  - ``src/ray/raylet/local_task_manager.h`` — dispatches to local workers
+    once dependencies are local and resources are free.
+  - ``src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h`` — pack
+    onto nodes below ``scheduler_spread_threshold`` utilization (prefer
+    lowest node id for determinism), then spread by least utilization.
+
+Node managers all live in the head process (one per simulated node, as in
+``ray.cluster_utils.Cluster`` which runs one raylet per simulated node on a
+single machine) but own real worker-process pools and their own resource
+ledgers, so scheduling, spillback, and node-failure semantics are real.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .config import config
+from .gcs import GlobalControlStore, NodeInfo
+from .ids import NodeID, PlacementGroupID
+from .object_store import SharedMemoryStore
+from .task_spec import SchedulingStrategy, TaskSpec, TaskType
+from .worker_pool import WorkerHandle, WorkerPool
+
+
+@dataclass
+class ResourceLedger:
+    """Tracks total/available scalar resources on one node.
+
+    Reference: ``LocalResourceManager`` with FixedPoint math; floats with a
+    small epsilon suffice here.
+    """
+
+    total: Dict[str, float]
+    available: Dict[str, float] = field(default_factory=dict)
+    _EPS = 1e-9
+
+    def __post_init__(self):
+        if not self.available:
+            self.available = dict(self.total)
+
+    def fits(self, demand: Dict[str, float]) -> bool:
+        return all(
+            self.available.get(k, 0.0) + self._EPS >= v for k, v in demand.items()
+        )
+
+    def can_ever_fit(self, demand: Dict[str, float]) -> bool:
+        return all(self.total.get(k, 0.0) + self._EPS >= v for k, v in demand.items())
+
+    def acquire(self, demand: Dict[str, float]) -> bool:
+        if not self.fits(demand):
+            return False
+        for k, v in demand.items():
+            self.available[k] = self.available.get(k, 0.0) - v
+        return True
+
+    def release(self, demand: Dict[str, float]) -> None:
+        for k, v in demand.items():
+            self.available[k] = min(
+                self.total.get(k, 0.0), self.available.get(k, 0.0) + v
+            )
+
+    def utilization(self) -> float:
+        if not self.total:
+            return 0.0
+        fracs = [
+            1.0 - self.available.get(k, 0.0) / t
+            for k, t in self.total.items()
+            if t > 0
+        ]
+        return max(fracs) if fracs else 0.0
+
+    def add_resources(self, extra: Dict[str, float]) -> None:
+        for k, v in extra.items():
+            self.total[k] = self.total.get(k, 0.0) + v
+            self.available[k] = self.available.get(k, 0.0) + v
+
+    def remove_resources(self, extra: Dict[str, float]) -> None:
+        for k, v in extra.items():
+            self.total[k] = max(0.0, self.total.get(k, 0.0) - v)
+            self.available[k] = max(0.0, self.available.get(k, 0.0) - v)
+
+
+class NodeManager:
+    """Per-node daemon: worker pool + store + local dispatch.
+
+    Reference: ``raylet/node_manager.h`` composing WorkerPool,
+    LocalTaskManager, the plasma store runner, and the dependency manager.
+    """
+
+    def __init__(self, node_id: NodeID, resources: Dict[str, float],
+                 message_handler: Callable, on_worker_death: Callable,
+                 object_store_memory: Optional[int] = None,
+                 env: Optional[dict] = None, labels: Optional[dict] = None):
+        self.node_id = node_id
+        self.ledger = ResourceLedger(dict(resources))
+        self.labels = labels or {}
+        num_workers = config().num_workers_per_node or max(
+            2, int(resources.get("CPU", 2))
+        )
+        self.store = SharedMemoryStore(node_id, object_store_memory)
+        self.pool = WorkerPool(node_id, num_workers, message_handler,
+                               on_worker_death, env=env)
+        # PG bundles reserved on this node: pg_id -> bundle_index -> resources
+        self.pg_bundles: Dict[Tuple[bytes, int], Dict[str, float]] = {}
+        self.alive = True
+
+    def start(self) -> None:
+        self.pool.start(prestart=config().prestart_workers)
+
+    def reserve_bundle(self, pg_id: PlacementGroupID, index: int,
+                       resources: Dict[str, float]) -> bool:
+        """Reference: PlacementGroupResourceManager::PrepareBundle."""
+        if not self.ledger.acquire(resources):
+            return False
+        self.pg_bundles[(pg_id.binary(), index)] = dict(resources)
+        return True
+
+    def return_bundle(self, pg_id: PlacementGroupID, index: int) -> None:
+        res = self.pg_bundles.pop((pg_id.binary(), index), None)
+        if res:
+            self.ledger.release(res)
+
+    def shutdown(self) -> None:
+        self.alive = False
+        self.pool.shutdown()
+        self.store.destroy()
+
+
+@dataclass
+class PendingLease:
+    spec: TaskSpec
+    on_granted: Callable[["NodeManager", WorkerHandle], None]
+    on_unschedulable: Callable[[str], None]
+    deps_ready: bool = False
+
+
+class ClusterScheduler:
+    """Cluster-level placement + local dispatch, one loop for all nodes.
+
+    The scheduling loop is event-driven: submissions, completions, dependency
+    readiness, and node membership changes all signal the condition variable.
+    """
+
+    def __init__(self, gcs: GlobalControlStore):
+        self._gcs = gcs
+        self._nodes: Dict[NodeID, NodeManager] = {}
+        self._queue: List[PendingLease] = []
+        self._lock = threading.RLock()
+        self._wake = threading.Condition(self._lock)
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        self._infeasible: List[PendingLease] = []
+        self._spread_index = 0
+
+    # -- membership ----------------------------------------------------------
+    def add_node(self, node: NodeManager, topology: Optional[dict] = None) -> None:
+        with self._lock:
+            self._nodes[node.node_id] = node
+            self._gcs.register_node(
+                NodeInfo(node.node_id, dict(node.ledger.total),
+                         labels=dict(node.labels), topology=topology or {})
+            )
+            self._recheck_infeasible_locked()
+            self._wake.notify_all()
+
+    def remove_node(self, node_id: NodeID) -> Optional[NodeManager]:
+        with self._lock:
+            node = self._nodes.pop(node_id, None)
+            if node is not None:
+                node.alive = False
+                self._gcs.mark_node_dead(node_id, "removed")
+            self._wake.notify_all()
+            return node
+
+    def get_node(self, node_id: NodeID) -> Optional[NodeManager]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def nodes(self) -> List[NodeManager]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, lease: PendingLease) -> None:
+        with self._lock:
+            self._queue.append(lease)
+            self._wake.notify_all()
+
+    def notify(self) -> None:
+        with self._lock:
+            self._wake.notify_all()
+
+    # -- policy (HybridSchedulingPolicy::Schedule) ---------------------------
+    def _pick_node(self, spec: TaskSpec) -> Optional[NodeManager]:
+        strat = spec.strategy
+        candidates = [n for n in self._nodes.values() if n.alive]
+        if not candidates:
+            return None
+        if strat.kind == "NODE_AFFINITY":
+            node = self._nodes.get(NodeID(strat.node_id))
+            if node is not None and node.alive and node.ledger.fits(spec.resources):
+                return node
+            if strat.soft:
+                pass  # fall through to hybrid placement
+            else:
+                return None
+        demand = dict(spec.resources)
+        if strat.kind == "PLACEMENT_GROUP" and strat.placement_group_id is not None:
+            # Restrict to the node holding the requested bundle; the bundle's
+            # reservation already holds the resources, so demand is checked
+            # against the bundle, not the free pool.
+            for node in candidates:
+                for (pg_bin, idx), res in node.pg_bundles.items():
+                    if pg_bin == strat.placement_group_id.binary() and (
+                        strat.bundle_index in (-1, idx)
+                    ):
+                        if all(res.get(k, 0.0) >= v for k, v in demand.items()):
+                            return node
+            return None
+        fitting = [n for n in candidates if n.ledger.fits(demand)]
+        if not fitting:
+            return None
+        if strat.kind == "SPREAD":
+            # Round-robin over feasible nodes (reference: spread policy
+            # rotates rather than re-picking the emptiest node, which would
+            # collapse to one node when tasks finish quickly).
+            fitting.sort(key=lambda n: n.node_id.binary())
+            self._spread_index += 1
+            return fitting[self._spread_index % len(fitting)]
+        threshold = config().scheduler_spread_threshold
+        below = [n for n in fitting if n.ledger.utilization() < threshold]
+        if below:
+            # Pack: deterministic lowest-id first among under-threshold nodes.
+            return min(below, key=lambda n: n.node_id.binary())
+        return min(fitting, key=lambda n: (n.ledger.utilization(),
+                                           n.node_id.binary()))
+
+    def _feasible_somewhere(self, spec: TaskSpec) -> bool:
+        if spec.strategy.kind == "PLACEMENT_GROUP":
+            return True  # bundle may appear when the PG is (re)scheduled
+        return any(
+            n.alive and n.ledger.can_ever_fit(spec.resources)
+            for n in self._nodes.values()
+        )
+
+    # -- loop ----------------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rt-scheduler")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            granted: List[Tuple[PendingLease, NodeManager, WorkerHandle]] = []
+            with self._lock:
+                if self._stopped:
+                    return
+                remaining: List[PendingLease] = []
+                for lease in self._queue:
+                    if not lease.deps_ready:
+                        remaining.append(lease)
+                        continue
+                    node = self._pick_node(lease.spec)
+                    if node is None:
+                        if self._feasible_somewhere(lease.spec):
+                            remaining.append(lease)
+                        else:
+                            self._infeasible.append(lease)
+                        continue
+                    if lease.spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                        # Actors get dedicated workers outside the pool cap
+                        # (reference: WorkerPool dedicated-worker path).
+                        worker = node.pool.start_dedicated(lease.spec.actor_id)
+                    else:
+                        worker = node.pool.try_pop_idle()
+                        if worker is None:
+                            remaining.append(lease)
+                            continue
+                    if lease.spec.strategy.kind != "PLACEMENT_GROUP":
+                        node.ledger.acquire(lease.spec.resources)
+                    granted.append((lease, node, worker))
+                self._queue = remaining
+                if not granted:
+                    self._wake.wait(timeout=0.05)
+            for lease, node, worker in granted:
+                try:
+                    lease.on_granted(node, worker)
+                except Exception as e:  # pragma: no cover — defensive
+                    lease.on_unschedulable(str(e))
+
+    def _recheck_infeasible_locked(self) -> None:
+        still = []
+        for lease in self._infeasible:
+            if self._feasible_somewhere(lease.spec):
+                self._queue.append(lease)
+            else:
+                still.append(lease)
+        self._infeasible = still
+
+    def release(self, node: NodeManager, spec: TaskSpec) -> None:
+        with self._lock:
+            if spec.strategy.kind != "PLACEMENT_GROUP":
+                node.ledger.release(spec.resources)
+            self._wake.notify_all()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._stopped = True
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        for node in self.nodes():
+            node.shutdown()

@@ -1,0 +1,156 @@
+"""Object serialization: pickle5 with out-of-band buffers.
+
+Reference analog: ``python/ray/_private/serialization.py`` — cloudpickle for
+code/closures, pickle protocol 5 out-of-band buffers for zero-copy numpy
+transfer through the shared-memory store, and in-band ObjectRef tracking so
+the owner learns about borrowers.
+
+TPU-specific: ``jax.Array`` values are serialized as host numpy copies plus
+sharding metadata (`DeviceArrayPayload`). Device buffers never transit the
+host object store when both sides share a mesh — the train/serve layers move
+weights by resharding inside compiled programs; this path is the fallback and
+the checkpoint path.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Tuple
+
+import cloudpickle
+
+_PROTOCOL = 5
+
+
+@dataclass
+class DeviceArrayPayload:
+    """Host-side representation of a jax.Array crossing the object plane."""
+
+    data: Any  # numpy array (out-of-band buffered)
+    sharding_spec: Optional[tuple] = None  # (mesh axis names, partition spec) if known
+
+    def to_device(self):
+        import jax
+
+        return jax.numpy.asarray(self.data)
+
+
+@dataclass
+class SerializedObject:
+    """In-band bytes + out-of-band buffers, ready for the object store."""
+
+    inband: bytes
+    buffers: List[pickle.PickleBuffer] = field(default_factory=list)
+    contained_refs: List[Any] = field(default_factory=list)
+
+    def total_bytes(self) -> int:
+        return len(self.inband) + sum(b.raw().nbytes for b in self.buffers)
+
+    def to_bytes(self) -> bytes:
+        """Flatten to one contiguous frame: [n][len(inband)][inband][bufs...]."""
+        out = io.BytesIO()
+        header = [len(self.inband)] + [b.raw().nbytes for b in self.buffers]
+        out.write(len(header).to_bytes(4, "little"))
+        for h in header:
+            out.write(h.to_bytes(8, "little"))
+        out.write(self.inband)
+        for b in self.buffers:
+            out.write(b.raw())
+        return out.getvalue()
+
+
+def _split_frames(data: memoryview) -> Tuple[memoryview, List[memoryview]]:
+    n = int.from_bytes(data[:4], "little")
+    sizes = [
+        int.from_bytes(data[4 + 8 * i : 12 + 8 * i], "little") for i in range(n)
+    ]
+    off = 4 + 8 * n
+    inband = data[off : off + sizes[0]]
+    off += sizes[0]
+    buffers = []
+    for s in sizes[1:]:
+        buffers.append(data[off : off + s])
+        off += s
+    return inband, buffers
+
+
+class Serializer:
+    """Pickles values; intercepts ObjectRefs (borrow tracking) and jax.Arrays."""
+
+    def __init__(self, ref_class=None, actor_handle_class=None):
+        self._ref_class = ref_class
+        self._actor_handle_class = actor_handle_class
+
+    def serialize(self, value: Any) -> SerializedObject:
+        buffers: List[pickle.PickleBuffer] = []
+        contained: List[Any] = []
+
+        def buffer_callback(buf: pickle.PickleBuffer) -> bool:
+            buffers.append(buf)
+            return False  # out-of-band
+
+        class _Pickler(cloudpickle.CloudPickler):
+            def persistent_id(self_inner, obj):  # noqa: N805
+                return None
+
+            def reducer_override(self_inner, obj):  # noqa: N805
+                if self._ref_class is not None and isinstance(obj, self._ref_class):
+                    contained.append(obj)
+                    return (self._ref_class._deserialize, (obj.id, obj.owner,))
+                try:
+                    import jax
+
+                    if isinstance(obj, jax.Array):
+                        import numpy as np
+
+                        spec = None
+                        try:
+                            sh = obj.sharding
+                            if hasattr(sh, "spec"):
+                                spec = (
+                                    tuple(sh.mesh.axis_names),
+                                    tuple(
+                                        tuple(p) if isinstance(p, (list, tuple)) else p
+                                        for p in tuple(sh.spec)
+                                    ),
+                                )
+                        except Exception:
+                            spec = None
+                        host = np.asarray(jax.device_get(obj))
+                        return (
+                            _rebuild_device_array,
+                            (DeviceArrayPayload(host, spec),),
+                        )
+                except ImportError:
+                    pass
+                return NotImplemented
+
+        f = io.BytesIO()
+        p = _Pickler(f, protocol=_PROTOCOL, buffer_callback=buffer_callback)
+        p.dump(value)
+        return SerializedObject(f.getvalue(), buffers, contained)
+
+    def deserialize(self, data: bytes | memoryview) -> Any:
+        view = memoryview(data)
+        inband, buffers = _split_frames(view)
+        return pickle.loads(inband, buffers=buffers)
+
+    def deserialize_parts(self, inband: bytes, buffers: List) -> Any:
+        return pickle.loads(inband, buffers=buffers)
+
+
+def _rebuild_device_array(payload: DeviceArrayPayload):
+    # Deserializing into a process with devices re-commits to the default
+    # device; resharding onto a mesh is the caller's concern (parallel/).
+    return payload.to_device()
+
+
+def dumps(value: Any) -> bytes:
+    """One-shot helper for control-plane payloads (no buffer extraction)."""
+    return cloudpickle.dumps(value, protocol=_PROTOCOL)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)

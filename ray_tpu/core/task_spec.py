@@ -1,0 +1,89 @@
+"""Task specification — the unit of scheduling and lineage.
+
+Reference analog: ``src/ray/common/task/task_spec.h`` (TaskSpecification) —
+carries the function descriptor, args (by value or by reference), resource
+demands, scheduling strategy, retry policy, and for actor tasks the actor id +
+sequence number. Retained by the owner's task manager for lineage
+reconstruction (``task_manager.h:105``).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, ObjectID, PlacementGroupID, TaskID
+
+
+class TaskType(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+    DRIVER_TASK = 3
+
+
+@dataclass
+class SchedulingStrategy:
+    """Where a task/actor may run.
+
+    Reference: ``python/ray/util/scheduling_strategies.py`` — DEFAULT, SPREAD,
+    PlacementGroupSchedulingStrategy, NodeAffinitySchedulingStrategy. Extended
+    here with a mesh claim (TPU subslice) dimension.
+    """
+
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP
+    node_id: Optional[bytes] = None
+    soft: bool = False
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    task_type: TaskType
+    # cloudpickled callable (normal task / actor factory) or method name.
+    function_blob: Optional[bytes]
+    method_name: Optional[str]
+    # Serialized (args, kwargs) frame; ObjectRefs appear as markers resolved
+    # by the dependency manager before dispatch.
+    args_frame: bytes
+    arg_refs: List[ObjectID] = field(default_factory=list)
+    # Refs nested inside args (passed through as refs, pinned until the task
+    # finishes — the borrower protocol of reference_count.h, simplified).
+    borrowed_refs: List[ObjectID] = field(default_factory=list)
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    # Actor fields
+    actor_id: Optional[ActorID] = None
+    actor_seq_no: int = 0
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    name: str = ""
+    runtime_env: Optional[dict] = None
+
+    def scheduling_key(self) -> Tuple:
+        """Lease reuse key: same-shape tasks share leased workers.
+
+        Reference: SchedulingKey in direct_task_transport.h — (function,
+        resources, strategy) tuples share worker leases.
+        """
+        return (
+            self.method_name or (self.function_blob[:32] if self.function_blob else b""),
+            tuple(sorted(self.resources.items())),
+            self.strategy.kind,
+            self.strategy.node_id,
+            self.strategy.placement_group_id,
+        )
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_return(self.task_id, i) for i in range(self.num_returns)]
+
+    def describe(self) -> str:
+        kind = self.task_type.name.lower()
+        return f"{kind} {self.name or self.method_name or 'fn'} [{self.task_id.hex()[:12]}]"

@@ -1,0 +1,299 @@
+"""Worker process: task execution loop.
+
+Reference analog: ``python/ray/_private/workers/default_worker.py`` +
+``_raylet.pyx`` ``run_task_loop``/``execute_task`` — a worker registers with
+its node, then loops receiving task pushes, resolving args, executing, and
+storing results (small results inline in the reply, large ones sealed into
+the shared-memory store directly, as in ``core_worker.h`` Put/SealOwned).
+
+Transport: a ``multiprocessing`` duplex pipe to the node's worker pool. A
+reader thread routes messages: task pushes go to an execution queue; replies
+to nested ``get``/``put``/``submit``/``wait`` RPCs (issued from inside user
+code via the worker-side runtime) resolve waiting futures by request id.
+This mirrors the core worker's own gRPC service + client pair.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import sys
+import threading
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+from . import serialization
+from .exceptions import ActorError, TaskError
+from .ids import ObjectID, TaskID
+from .object_ref import ObjectRef, install_refcount_hooks
+from .object_store import ShmClient
+from .serialization import Serializer
+from .task_spec import TaskType
+
+_INLINE_LIMIT_ENV = "RT_MAX_DIRECT_CALL_OBJECT_SIZE"
+
+
+class _ArgSentinel:
+    """Placeholder for a top-level ObjectRef arg, replaced before execution."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+
+class WorkerRuntime:
+    """The in-worker runtime backing the public API inside tasks.
+
+    Supports nested ``remote``/``get``/``put``/``wait`` by RPC to the owner
+    process over the pipe (the reference routes these through the raylet and
+    owner core worker; single-host we go straight to the head runtime).
+    """
+
+    def __init__(self, conn, worker_id_hex: str, node_id_hex: str):
+        self.conn = conn
+        self.worker_id_hex = worker_id_hex
+        self.node_id_hex = node_id_hex
+        self.shm = ShmClient()
+        self.serializer = Serializer(ref_class=ObjectRef)
+        self._send_lock = threading.Lock()
+        self._pending_rpcs: Dict[int, Future] = {}
+        self._rpc_counter = 0
+        self._rpc_lock = threading.Lock()
+        self._task_queue: "queue.Queue" = queue.Queue()
+        self._actors: Dict[str, Any] = {}
+        self._actor_executors: Dict[str, ThreadPoolExecutor] = {}
+        self._shutdown = threading.Event()
+        self.current_task_id: Optional[TaskID] = None
+        self._put_counter = 0
+        install_refcount_hooks()  # no-op hooks in workers; owner tracks refs
+
+    # -- transport -----------------------------------------------------------
+    def _send(self, msg) -> None:
+        with self._send_lock:
+            self.conn.send(msg)
+
+    def _rpc(self, kind: str, *payload) -> Any:
+        with self._rpc_lock:
+            self._rpc_counter += 1
+            req_id = self._rpc_counter
+            fut: Future = Future()
+            self._pending_rpcs[req_id] = fut
+        self._send((kind, req_id) + payload)
+        return fut.result()
+
+    def _reader_loop(self) -> None:
+        try:
+            while not self._shutdown.is_set():
+                msg = self.conn.recv()
+                kind = msg[0]
+                if kind == "exec":
+                    self._task_queue.put(msg)
+                elif kind == "reply":
+                    _, req_id, ok, value = msg
+                    with self._rpc_lock:
+                        fut = self._pending_rpcs.pop(req_id, None)
+                    if fut is not None:
+                        if ok:
+                            fut.set_result(value)
+                        else:
+                            fut.set_exception(value)
+                elif kind == "exit":
+                    self._shutdown.set()
+                    self._task_queue.put(None)
+                elif kind == "drain_exit":
+                    # Graceful: already-queued tasks run first, then the
+                    # loop stops (reference: __ray_terminate__ semantics).
+                    self._task_queue.put(None)
+        except (EOFError, OSError):
+            self._shutdown.set()
+            self._task_queue.put(None)
+            os._exit(1)
+
+    # -- public-API backing (called via ray_tpu.get/put/... inside tasks) ----
+    def get(self, refs, timeout=None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        payload = self._rpc("get", [r.id.binary() for r in ref_list], timeout)
+        values = [self._materialize(entry) for entry in payload]
+        for v in values:
+            if isinstance(v, Exception):
+                raise v
+        return values[0] if single else values
+
+    def put(self, value):
+        serialized = self.serializer.serialize(value)
+        frame = serialized.to_bytes()
+        self._put_counter += 1
+        inline_limit = int(os.environ.get(_INLINE_LIMIT_ENV, 100 * 1024))
+        task_id = self.current_task_id or TaskID.nil()
+        object_id = ObjectID.for_put(task_id, self._put_counter)
+        if len(frame) <= inline_limit:
+            oid_bin = self._rpc("put", object_id.binary(), ("inline", frame))
+        else:
+            self.shm.create_and_seal(object_id, frame)
+            oid_bin = self._rpc("put", object_id.binary(), ("shm", len(frame)))
+        return ObjectRef(ObjectID(oid_bin))
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        ids = [r.id.binary() for r in refs]
+        ready_bins = self._rpc("wait", ids, num_returns, timeout)
+        ready_set = set(ready_bins)
+        ready = [r for r in refs if r.id.binary() in ready_set]
+        not_ready = [r for r in refs if r.id.binary() not in ready_set]
+        return ready, not_ready
+
+    def submit_task(self, spec_blob: bytes):
+        """Nested task/actor submission; owner stays the head runtime (v1)."""
+        return_bins = self._rpc("submit", spec_blob)
+        return [ObjectRef(ObjectID(b)) for b in return_bins]
+
+    def submit_spec(self, spec):
+        return self.submit_task(serialization.dumps(spec))
+
+    def kill_actor(self, actor_id_bin: bytes, no_restart: bool = True):
+        return self._rpc("kill_actor", actor_id_bin, no_restart)
+
+    def cancel(self, object_id_bin: bytes, force: bool):
+        return self._rpc("cancel", object_id_bin, force)
+
+    def _materialize(self, entry):
+        kind, payload = entry
+        if kind == "inline":
+            return self.serializer.deserialize(payload)
+        if kind == "shm":
+            oid_bin, size = payload
+            view = self.shm.read(ObjectID(oid_bin), size)
+            return self.serializer.deserialize(view)
+        if kind == "error":
+            return payload
+        raise ValueError(f"bad entry kind {kind}")
+
+    # -- task execution ------------------------------------------------------
+    def _resolve_args(self, args_frame: bytes, resolved: Dict[int, Any]):
+        args, kwargs = self.serializer.deserialize(args_frame)
+
+        def sub(x):
+            return resolved[x.index] if isinstance(x, _ArgSentinel) else x
+
+        args = [sub(a) for a in args]
+        kwargs = {k: sub(v) for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _store_results(self, task_id_hex: str, values, num_returns: int):
+        """Serialize results; inline small, seal large into shm."""
+        if num_returns == 1:
+            values = [values]
+        elif num_returns == 0:
+            values = []
+        else:
+            values = list(values)
+            if len(values) != num_returns:
+                raise ValueError(
+                    f"task declared num_returns={num_returns} but returned "
+                    f"{len(values)} values"
+                )
+        inline_limit = int(os.environ.get(_INLINE_LIMIT_ENV, 100 * 1024))
+        out = []
+        task_id = TaskID.from_hex(task_id_hex)
+        for i, v in enumerate(values):
+            frame = self.serializer.serialize(v).to_bytes()
+            oid = ObjectID.for_return(task_id, i)
+            if len(frame) <= inline_limit:
+                out.append(("inline", frame))
+            else:
+                self.shm.create_and_seal(oid, frame)
+                out.append(("shm", len(frame)))
+        return out
+
+    def _execute_one(self, msg) -> None:
+        (_, task_id_hex, payload) = msg
+        task_type = TaskType(payload["task_type"])
+        prev_task = self.current_task_id
+        self.current_task_id = TaskID.from_hex(task_id_hex)
+        try:
+            resolved = {
+                i: self._materialize(entry)
+                for i, entry in payload.get("resolved_args", {}).items()
+            }
+            args, kwargs = self._resolve_args(payload["args_frame"], resolved)
+            if task_type == TaskType.NORMAL_TASK:
+                fn = serialization.loads(payload["function_blob"])
+                result = fn(*args, **kwargs)
+            elif task_type == TaskType.ACTOR_CREATION_TASK:
+                cls = serialization.loads(payload["function_blob"])
+                instance = cls(*args, **kwargs)
+                actor_hex = payload["actor_id"]
+                self._actors[actor_hex] = instance
+                maxc = payload.get("max_concurrency", 1)
+                if maxc > 1:
+                    self._actor_executors[actor_hex] = ThreadPoolExecutor(maxc)
+                result = None
+            elif task_type == TaskType.ACTOR_TASK:
+                actor_hex = payload["actor_id"]
+                instance = self._actors.get(actor_hex)
+                if instance is None:
+                    raise ActorError(msg="actor instance not found on worker")
+                method = getattr(instance, payload["method_name"])
+                result = method(*args, **kwargs)
+                import inspect
+
+                if inspect.iscoroutine(result):
+                    import asyncio
+
+                    result = asyncio.new_event_loop().run_until_complete(result)
+            else:
+                raise ValueError(f"bad task type {task_type}")
+            results = self._store_results(
+                task_id_hex, result, payload["num_returns"]
+            )
+            self._send(("done", task_id_hex, results))
+        except BaseException as e:  # noqa: BLE001 — report, owner decides retry
+            err = TaskError.from_exception(e, payload.get("name", ""))
+            self._send(("error", task_id_hex, serialization.dumps(err),
+                        isinstance(e, Exception)))
+        finally:
+            self.current_task_id = prev_task
+
+    def run_task_loop(self) -> None:
+        reader = threading.Thread(target=self._reader_loop, daemon=True,
+                                  name="worker-reader")
+        reader.start()
+        self._send(("register", os.getpid()))
+        while not self._shutdown.is_set():
+            msg = self._task_queue.get()
+            if msg is None:
+                break
+            payload = msg[2]
+            actor_hex = payload.get("actor_id")
+            executor = self._actor_executors.get(actor_hex) if actor_hex else None
+            if executor is not None and TaskType(payload["task_type"]) == TaskType.ACTOR_TASK:
+                executor.submit(self._execute_one, msg)
+            else:
+                self._execute_one(msg)
+        self.shm.close()
+
+
+_worker_runtime: Optional[WorkerRuntime] = None
+
+
+def get_worker_runtime() -> Optional[WorkerRuntime]:
+    return _worker_runtime
+
+
+def worker_entry(conn, worker_id_hex: str, node_id_hex: str, env: dict) -> None:
+    """Child-process entrypoint (spawned by the worker pool)."""
+    global _worker_runtime
+    os.environ.update(env or {})
+    # Make this process identifiable in `ps` (reference: setproctitle).
+    sys.argv[0] = f"rt::worker::{worker_id_hex[:8]}"
+    _worker_runtime = WorkerRuntime(conn, worker_id_hex, node_id_hex)
+    # Route the public API to this runtime inside the worker process.
+    from . import runtime as runtime_mod
+
+    runtime_mod._set_worker_mode(_worker_runtime)
+    try:
+        _worker_runtime.run_task_loop()
+    except KeyboardInterrupt:
+        pass

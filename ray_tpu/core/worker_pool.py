@@ -1,0 +1,225 @@
+"""Worker pool: spawns and leases worker processes.
+
+Reference analog: ``src/ray/raylet/worker_pool.h`` — pre-starts language
+workers, pops an idle worker per granted lease, starts replacements on
+demand, reaps surplus idle workers. Dedicated workers for actors. Each
+worker here is a real OS process (``multiprocessing`` spawn context, safe
+with JAX) connected by a duplex pipe; a per-worker handler thread in the
+owner process routes task replies and nested-RPC requests.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .ids import NodeID, WorkerID
+
+_MP = mp.get_context("spawn")
+
+
+class WorkerHandle:
+    """Owner-side handle to one worker process."""
+
+    IDLE = "IDLE"
+    LEASED = "LEASED"
+    DEDICATED = "DEDICATED"  # bound to an actor for its lifetime
+    DEAD = "DEAD"
+
+    def __init__(self, worker_id: WorkerID, node_id: NodeID, process, conn):
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.process = process
+        self.conn = conn
+        self.state = WorkerHandle.IDLE
+        self.actor_id = None
+        self.current_tasks: set = set()
+        self.lease_expiry: float = 0.0
+        self._send_lock = threading.Lock()
+        self._registered = threading.Event()
+        self._handler_thread: Optional[threading.Thread] = None
+
+    def send(self, msg) -> bool:
+        with self._send_lock:
+            try:
+                self.conn.send(msg)
+                return True
+            except (BrokenPipeError, OSError):
+                return False
+
+    def alive(self) -> bool:
+        return self.state != WorkerHandle.DEAD and self.process.is_alive()
+
+    def kill(self) -> None:
+        self.state = WorkerHandle.DEAD
+        try:
+            self.send(("exit",))
+        except Exception:
+            pass
+        if self.process.is_alive():
+            self.process.terminate()
+
+
+class WorkerPool:
+    """Per-node pool of worker processes.
+
+    ``message_handler(worker, msg)`` is supplied by the runtime and receives
+    every inbound message ("register", "done", "error", nested RPCs).
+    ``on_worker_death(worker)`` lets the node manager fail running tasks and
+    restart actors (reference: NodeManager worker-failure path).
+    """
+
+    def __init__(self, node_id: NodeID, size: int,
+                 message_handler: Callable, on_worker_death: Callable,
+                 env: Optional[dict] = None):
+        self.node_id = node_id
+        self.size = size
+        self.env = env or {}
+        self._message_handler = message_handler
+        self._on_worker_death = on_worker_death
+        self._workers: Dict[WorkerID, WorkerHandle] = {}
+        self._lock = threading.RLock()
+        self._stopped = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, prestart: bool = True) -> None:
+        if prestart:
+            for _ in range(self.size):
+                self._start_worker()
+
+    def _start_worker(self) -> WorkerHandle:
+        from .worker_main import worker_entry
+
+        worker_id = WorkerID.from_random()
+        parent_conn, child_conn = _MP.Pipe(duplex=True)
+        proc = _MP.Process(
+            target=worker_entry,
+            args=(child_conn, worker_id.hex(), self.node_id.hex(), self.env),
+            daemon=True,
+            name=f"rt-worker-{worker_id.hex()[:8]}",
+        )
+        proc.start()
+        child_conn.close()
+        handle = WorkerHandle(worker_id, self.node_id, proc, parent_conn)
+        with self._lock:
+            self._workers[worker_id] = handle
+        t = threading.Thread(
+            target=self._handler_loop, args=(handle,), daemon=True,
+            name=f"rt-pump-{worker_id.hex()[:8]}",
+        )
+        handle._handler_thread = t
+        t.start()
+        return handle
+
+    def _handler_loop(self, worker: WorkerHandle) -> None:
+        try:
+            while not self._stopped.is_set():
+                msg = worker.conn.recv()
+                if msg[0] == "register":
+                    worker._registered.set()
+                self._message_handler(worker, msg)
+        except (EOFError, OSError):
+            pass
+        if not self._stopped.is_set() and worker.state != WorkerHandle.DEAD:
+            worker.state = WorkerHandle.DEAD
+            self._on_worker_death(worker)
+
+    # -- leasing (reference: PopWorker / PushWorker) -------------------------
+    def pop_idle(self, wait_timeout: float = 30.0) -> Optional[WorkerHandle]:
+        deadline = time.monotonic() + wait_timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                for w in self._workers.values():
+                    if w.state == WorkerHandle.IDLE and w.alive() and w._registered.is_set():
+                        w.state = WorkerHandle.LEASED
+                        return w
+                have_capacity = len(self._alive()) < self.size
+            if have_capacity:
+                handle = self._start_worker()
+                handle._registered.wait(timeout=wait_timeout)
+                with self._lock:
+                    if handle.state == WorkerHandle.IDLE:
+                        handle.state = WorkerHandle.LEASED
+                        return handle
+            else:
+                time.sleep(0.002)
+        return None
+
+    def try_pop_idle(self) -> Optional[WorkerHandle]:
+        with self._lock:
+            for w in self._workers.values():
+                if w.state == WorkerHandle.IDLE and w.alive() and w._registered.is_set():
+                    w.state = WorkerHandle.LEASED
+                    return w
+            if len(self._alive()) < self.size:
+                pass_start = True
+            else:
+                return None
+        if pass_start:
+            handle = self._start_worker()
+            handle._registered.wait(timeout=30)
+            with self._lock:
+                if handle.state == WorkerHandle.IDLE:
+                    handle.state = WorkerHandle.LEASED
+                    return handle
+        return None
+
+    def return_worker(self, worker: WorkerHandle) -> None:
+        with self._lock:
+            if worker.state == WorkerHandle.LEASED:
+                worker.state = WorkerHandle.IDLE
+
+    def dedicate(self, worker: WorkerHandle, actor_id) -> None:
+        with self._lock:
+            worker.state = WorkerHandle.DEDICATED
+            worker.actor_id = actor_id
+
+    def start_dedicated(self, actor_id) -> WorkerHandle:
+        """Spawn a worker outside the pool cap, bound to an actor for life.
+
+        Reference: WorkerPool starts dedicated workers for actor creation
+        tasks rather than consuming the idle pool.
+        """
+        handle = self._start_worker()
+        with self._lock:
+            handle.state = WorkerHandle.DEDICATED
+            handle.actor_id = actor_id
+        return handle
+
+    def grow(self, n: int = 1) -> None:
+        """Temporarily exceed pool size (blocked-worker compensation)."""
+        with self._lock:
+            self.size += n
+        for _ in range(n):
+            self._start_worker()
+
+    def _alive(self) -> List[WorkerHandle]:
+        """Alive workers counted against the pool cap (excludes dedicated)."""
+        return [w for w in self._workers.values()
+                if w.alive() and w.state != WorkerHandle.DEDICATED]
+
+    def num_idle(self) -> int:
+        with self._lock:
+            return sum(1 for w in self._workers.values()
+                       if w.state == WorkerHandle.IDLE and w.alive())
+
+    def get(self, worker_id: WorkerID) -> Optional[WorkerHandle]:
+        with self._lock:
+            return self._workers.get(worker_id)
+
+    def all_workers(self) -> List[WorkerHandle]:
+        with self._lock:
+            return list(self._workers.values())
+
+    def shutdown(self) -> None:
+        self._stopped.set()
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            w.kill()
+        for w in workers:
+            w.process.join(timeout=2)
+            if w.process.is_alive():
+                w.process.kill()

@@ -1,0 +1,164 @@
+"""Actor tests: creation, ordered methods, named actors, FT.
+
+Mirrors reference coverage in ``python/ray/tests/test_actor*.py``.
+"""
+
+import time
+
+import pytest
+
+
+def test_actor_basic(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert rt.get(c.incr.remote()) == 11
+    assert rt.get(c.incr.remote(5)) == 16
+    assert rt.get(c.value.remote()) == 16
+
+
+def test_actor_method_ordering(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+            return len(self.items)
+
+        def get_items(self):
+            return self.items
+
+    a = Appender.remote()
+    for i in range(20):
+        a.add.remote(i)
+    assert rt.get(a.get_items.remote()) == list(range(20))
+
+
+def test_actor_state_isolated(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    class Holder:
+        def __init__(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    a, b = Holder.remote("a"), Holder.remote("b")
+    assert rt.get([a.get.remote(), b.get.remote()]) == ["a", "b"]
+
+
+def test_actor_error_in_method(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    class Fragile:
+        def boom(self):
+            raise RuntimeError("actor method failed")
+
+        def ok(self):
+            return "still alive"
+
+    f = Fragile.remote()
+    with pytest.raises(Exception, match="actor method failed"):
+        rt.get(f.boom.remote())
+    # Method errors don't kill the actor.
+    assert rt.get(f.ok.remote()) == "still alive"
+
+
+def test_actor_constructor_error(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    class Broken:
+        def __init__(self):
+            raise ValueError("ctor failed")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises(Exception):
+        rt.get(b.m.remote(), timeout=10)
+
+
+def test_named_actor(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    class Registry:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = v
+
+        def get(self, k):
+            return self.d.get(k)
+
+    Registry.options(name="registry-test").remote()
+    h = rt.get_actor("registry-test")
+    rt.get(h.set.remote("x", 42))
+    assert rt.get(h.get.remote("x")) == 42
+
+
+def test_actor_handle_passed_to_task(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    class Store:
+        def __init__(self):
+            self.v = 0
+
+        def set(self, v):
+            self.v = v
+            return "set"
+
+        def get(self):
+            return self.v
+
+    @rt.remote
+    def writer(handle, v):
+        import ray_tpu as rt2
+
+        return rt2.get(handle.set.remote(v))
+
+    s = Store.remote()
+    assert rt.get(writer.remote(s, 99)) == "set"
+    assert rt.get(s.get.remote()) == 99
+
+
+def test_max_concurrency(rt_shared):
+    rt = rt_shared
+
+    @rt.remote(max_concurrency=4)
+    class Parallel:
+        def block(self, t):
+            time.sleep(t)
+            return "done"
+
+    p = Parallel.remote()
+    rt.get(p.block.remote(0.01))  # wait for creation before timing
+    t0 = time.time()
+    refs = [p.block.remote(0.5) for _ in range(4)]
+    rt.get(refs)
+    elapsed = time.time() - t0
+    # 4 concurrent 0.5s calls should take ~0.5s, not 2s.
+    assert elapsed < 1.8, f"max_concurrency not concurrent: {elapsed}"

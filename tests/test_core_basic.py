@@ -1,0 +1,187 @@
+"""Core API tests: tasks, objects, put/get/wait.
+
+Mirrors the reference's ``python/ray/tests/test_basic.py`` coverage.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def test_put_get(rt_shared):
+    rt = rt_shared
+    ref = rt.put(42)
+    assert rt.get(ref) == 42
+
+
+def test_put_get_large_numpy(rt_shared):
+    rt = rt_shared
+    arr = np.arange(1_000_000, dtype=np.float32)  # ~4MB -> shm path
+    ref = rt.put(arr)
+    out = rt.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    def f(x):
+        return x * 2
+
+    assert rt.get(f.remote(2)) == 4
+
+
+def test_task_with_ref_arg(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    x = rt.put(1)
+    y = add.remote(x, 2)
+    z = add.remote(y, 4)
+    assert rt.get(z) == 7
+
+
+def test_many_tasks(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(50)]
+    assert rt.get(refs) == [i * i for i in range(50)]
+
+
+def test_multiple_returns(rt_shared):
+    rt = rt_shared
+
+    @rt.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert rt.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    def boom():
+        raise ValueError("kapow")
+
+    with pytest.raises(Exception) as exc_info:
+        rt.get(boom.remote())
+    assert "kapow" in str(exc_info.value)
+
+
+def test_error_cascades_through_deps(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    def boom():
+        raise ValueError("root cause")
+
+    @rt.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(Exception) as exc_info:
+        rt.get(consume.remote(boom.remote()))
+    assert "root cause" in str(exc_info.value)
+
+
+def test_wait(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    def fast():
+        return "fast"
+
+    @rt.remote
+    def slow():
+        time.sleep(2)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = rt.wait([f, s], num_returns=1, timeout=1.5)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_get_timeout(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    def sleepy():
+        time.sleep(5)
+
+    with pytest.raises(rt.GetTimeoutError):
+        rt.get(sleepy.remote(), timeout=0.2)
+
+
+def test_nested_tasks(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    def inner(x):
+        return x + 1
+
+    @rt.remote
+    def outer(x):
+        import ray_tpu as rt2
+
+        return rt2.get(inner.remote(x)) + 10
+
+    assert rt.get(outer.remote(1)) == 12
+
+
+def test_nested_refs_pass_through(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    def make():
+        return 7
+
+    @rt.remote
+    def takes_list(refs):
+        import ray_tpu as rt2
+
+        return sum(rt2.get(refs))
+
+    refs = [make.remote() for _ in range(3)]
+    assert rt.get(takes_list.remote(refs)) == 21
+
+
+def test_put_inside_task(rt_shared):
+    rt = rt_shared
+
+    @rt.remote
+    def producer():
+        import ray_tpu as rt2
+
+        return rt2.put([1, 2, 3])
+
+    inner_ref = rt.get(producer.remote())
+    assert rt.get(inner_ref) == [1, 2, 3]
+
+
+def test_options_override(rt_shared):
+    rt = rt_shared
+
+    @rt.remote(num_cpus=1)
+    def f():
+        return "ok"
+
+    assert rt.get(f.options(num_cpus=2).remote()) == "ok"
+
+
+def test_cluster_resources(rt_shared):
+    rt = rt_shared
+    res = rt.cluster_resources()
+    assert res.get("CPU", 0) >= 4
