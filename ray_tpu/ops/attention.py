@@ -1,0 +1,255 @@
+"""Attention ops: Pallas flash attention (TPU) + reference implementation.
+
+The reference framework has no attention kernels (model code is user-space
+there); this framework ships them because long-context SP/ring attention is
+first-class (SURVEY §5.7). Design follows the standard online-softmax flash
+algorithm, tiled for the MXU:
+
+  - grid over (batch*heads, query blocks)
+  - K/V stream through VMEM in ``block_k`` chunks with running (m, l, acc)
+  - causal masking skips fully-masked K blocks (block-level early exit)
+  - bf16 inputs, fp32 accumulation (``preferred_element_type``)
+
+``flash_attention`` is differentiable: forward = Pallas kernel, backward =
+blockwise recompute in XLA (flash-style memory footprint, no S×S
+materialization).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Reference implementation (also the CPU-test path and the backward building
+# block). Shapes: q [B, H, Sq, D], k/v [B, H, Sk, D].
+# ---------------------------------------------------------------------------
+
+def mha_reference(q, k, v, causal: bool = True,
+                  scale: Optional[float] = None,
+                  q_offset: int = 0):
+    """Plain attention; ``q_offset`` shifts causal positions (ring steps)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        q_pos = jnp.arange(sq)[:, None] + q_offset
+        k_pos = jnp.arange(sk)[None, :]
+        logits = jnp.where(q_pos >= k_pos, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      *, block_k: int, seq_k: int, scale: float,
+                      causal: bool, block_q: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+    d = q.shape[-1]
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    num_kb = seq_k // block_k
+    if causal:
+        # Only K blocks at or before this Q block's diagonal contribute.
+        upper = jnp.minimum(
+            num_kb, (qi + 1) * block_q // block_k + (block_q // block_k == 0)
+        )
+        upper = jnp.maximum(upper, 1)
+    else:
+        upper = num_kb
+
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        if causal:
+            k_pos = (
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                + kb * block_k
+            )
+            s = jnp.where(q_pos + qi * block_q >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    safe_l = jnp.where(l == 0, 1.0, l)
+    o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(safe_l)  # [block_q, 1]
+
+
+def _flash_fwd_pallas(q, k, v, causal: bool, scale: float,
+                      block_q: int, block_k: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, sq, d)
+    k3 = k.reshape(bh, sk, d)
+    v3 = v.reshape(bh, sk, d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    grid = (bh, sq // block_q)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_k=block_k, seq_k=sk, scale=scale,
+        causal=causal, block_q=block_q,
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+    ]
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(q3, k3, v3)
+    return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+# ---------------------------------------------------------------------------
+# Differentiable wrapper: pallas forward, blockwise-recompute backward.
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    o, _ = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                             interpret=not _on_tpu())
+    return o
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
+    o, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                               interpret=not _on_tpu())
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, res, do):
+    """Blockwise backward in plain XLA: recompute P per K block from the
+    saved LSE (no S×S materialization across blocks)."""
+    q, k, v, o, lse = res
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    sq, sk = q.shape[2], k.shape[2]
+
+    # delta = rowsum(dO * O)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # [B,H,Sq]
+
+    n_blocks = max(1, sk // block_k)
+
+    def body(kb, carry):
+        dq, dk, dv = carry
+        ks = jax.lax.dynamic_slice_in_dim(kf, kb * block_k, block_k, axis=2)
+        vs = jax.lax.dynamic_slice_in_dim(vf, kb * block_k, block_k, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, ks,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = jnp.arange(sq)[:, None]
+            k_pos = jnp.arange(block_k)[None, :] + kb * block_k
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # [B,H,Sq,block_k]
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, dof,
+                            preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vs,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * scale
+        dq_blk = jnp.einsum("bhqk,bhkd->bhqd", ds, ks,
+                            preferred_element_type=jnp.float32)
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf,
+                            preferred_element_type=jnp.float32)
+        dk = jax.lax.dynamic_update_slice_in_dim(
+            dk, dk_blk, kb * block_k, axis=2)
+        dv = jax.lax.dynamic_update_slice_in_dim(
+            dv, dv_blk, kb * block_k, axis=2)
+        return dq + dq_blk, dk, dv
+
+    dq0 = jnp.zeros_like(qf)
+    dk0 = jnp.zeros_like(kf)
+    dv0 = jnp.zeros_like(vf)
+    dq, dk, dv = jax.lax.fori_loop(0, n_blocks, body, (dq0, dk0, dv0))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Flash attention. q/k/v: [batch, heads, seq, head_dim].
+
+    Pallas kernel on TPU; interpreter mode (same code path) on CPU tests.
+    Falls back to :func:`mha_reference` for shapes the kernel can't tile.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    sq, sk = q.shape[2], k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    if sq % bq != 0 or sk % bk != 0 or (causal and bq % bk != 0 and bk % bq != 0):
+        return mha_reference(q, k, v, causal=causal, scale=scale)
+    return _flash(q, k, v, causal, scale, bq, bk)
+
+
+def attention(q, k, v, causal: bool = True, impl: str = "auto",
+              scale: Optional[float] = None):
+    """Dispatch: 'flash' | 'reference' | 'auto' (flash on TPU)."""
+    if impl == "reference" or (impl == "auto" and not _on_tpu()):
+        return mha_reference(q, k, v, causal=causal, scale=scale)
+    return flash_attention(q, k, v, causal=causal, scale=scale)

@@ -1,0 +1,123 @@
+"""Logical-axis sharding rules: annotate once, run on any mesh.
+
+The reference has no analog — model sharding is delegated to user code
+(SURVEY §2.4 "Model sharding inside Train workers: delegated"). Here it is
+first-class: parameters and activations carry *logical* axis names
+("embed", "mlp", "heads", "batch", "seq"), and a rule table maps logical
+axes to mesh axes. Changing the parallelism layout = changing the rule
+table, not the model.
+
+This is the standard scaling-book recipe: pick a mesh, annotate shardings,
+let XLA insert collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None = replicate)
+Rules = Dict[str, Union[str, Tuple[str, ...], None]]
+
+# Default rule table for transformer LMs. fsdp shards the embed dim of
+# params (ZeRO-3 style); tp shards heads/mlp; sp shards activation seq.
+DEFAULT_RULES: Rules = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": "fsdp",
+    "heads": "tp",
+    "kv": None,
+    "mlp": "tp",
+    "vocab": "tp",
+    "layers": None,
+    "stage": "pp",
+    "expert": "ep",
+    "qkv": "tp",
+}
+
+
+def spec_for(logical_axes: Sequence[Optional[str]],
+             rules: Optional[Rules] = None) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec."""
+    rules = dict(DEFAULT_RULES, **(rules or {}))
+    out = []
+    for ax in logical_axes:
+        if ax is None:
+            out.append(None)
+        else:
+            out.append(rules.get(ax))
+    # Trim trailing Nones for cleanliness.
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def tree_spec(logical_tree: Any, rules: Optional[Rules] = None) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes: spec_for(axes, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x
+        ),
+    )
+
+
+def shardings_for(mesh: Mesh, logical_tree: Any,
+                  rules: Optional[Rules] = None) -> Any:
+    """Pytree of NamedShardings for placing arrays on the mesh."""
+    specs = tree_spec(logical_tree, rules)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def constrain(x, logical_axes: Sequence[Optional[str]],
+              rules: Optional[Rules] = None):
+    """``with_sharding_constraint`` by logical axis names (inside jit)."""
+    return jax.lax.with_sharding_constraint(x, spec_for(logical_axes, rules))
+
+
+def prune_rules_for_mesh(mesh: Mesh, rules: Optional[Rules] = None) -> Rules:
+    """Drop rule entries referring to axes absent from (or trivial in) the
+    mesh so the same model code runs on any mesh shape."""
+    rules = dict(DEFAULT_RULES, **(rules or {}))
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    def keep(mesh_axis):
+        return mesh_axis is not None and sizes.get(mesh_axis, 1) > 1
+
+    out: Rules = {}
+    for logical, mesh_axis in rules.items():
+        if mesh_axis is None:
+            out[logical] = None
+        elif isinstance(mesh_axis, tuple):
+            kept = tuple(a for a in mesh_axis if keep(a))
+            out[logical] = kept if kept else None
+        else:
+            out[logical] = mesh_axis if keep(mesh_axis) else None
+    return out
+
+
+def place(mesh: Mesh, tree: Any, logical_tree: Any,
+          rules: Optional[Rules] = None) -> Any:
+    """Device-put a pytree onto the mesh under the rule table."""
+    shardings = shardings_for(mesh, logical_tree, rules)
+    return jax.device_put(tree, shardings)
+
+
+def smap(f, mesh: Mesh, in_specs, out_specs):
+    """``shard_map`` with version compat (jax>=0.8 moved it to jax.shard_map
+    and renamed check_rep->check_vma)."""
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)

@@ -8,13 +8,23 @@ an 8-device CPU mesh exactly as they would over a TPU slice.
 import os
 
 # Must be set before any jax import (including transitively via ray_tpu).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# NOTE: the environment pre-sets JAX_PLATFORMS (e.g. to a TPU plugin), so
+# overwrite rather than setdefault — tests always run on the virtual
+# 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (
         prev + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The image's sitecustomize imports jax at interpreter startup (before this
+# file runs), so the env var alone is too late for THIS process — update the
+# live config too. Worker subprocesses get the env var via inheritance.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
