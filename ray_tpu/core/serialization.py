@@ -125,7 +125,9 @@ class Serializer:
                         )
                 except ImportError:
                     pass
-                return NotImplemented
+                # Delegate to CloudPickler so local functions/classes keep
+                # their by-value reduction.
+                return super().reducer_override(obj)
 
         f = io.BytesIO()
         p = _Pickler(f, protocol=_PROTOCOL, buffer_callback=buffer_callback)

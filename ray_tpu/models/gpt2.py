@@ -1,0 +1,241 @@
+"""GPT-2 family — the flagship model (north-star config: 1.5B at ≥45% MFU).
+
+Pure-function transformer LM over param pytrees (see ``models/common.py``):
+learned positional embeddings, pre-LN blocks, GELU MLP, tied LM head —
+matching the GPT-2 architecture the baseline targets
+(``BASELINE.md``: "GPT-2 355M/1.5B DP over ICI").
+
+TPU design choices:
+  - bf16 activations + matmuls with fp32 layernorm/softmax/loss
+  - per-layer ``jax.checkpoint`` (remat) so 1.5B trains at seq 1024+
+  - layers stacked into one scanned super-layer (single compile of the
+    block; XLA unrolls collectives per iteration)
+  - attention pluggable: flash (pallas), reference, ring (sp), ulysses (sp)
+  - every activation/param annotated with logical axes for the
+    dp/fsdp/tp/sp rule table (``parallel/sharding.py``)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention as attention_op
+from ..parallel.sharding import constrain
+from .common import cross_entropy_loss, layer_norm, truncated_normal
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50304  # padded to 128 multiple (50257 -> 50304)
+    max_seq: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_mlp: Optional[int] = None
+    dropout: float = 0.0  # benchmark configs run dropout-free
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "auto"  # auto|flash|reference|ring|ulysses
+    remat: bool = True
+    # "full" recomputes everything; "dots" saves matmul outputs and
+    # recomputes only cheap elementwise ops — the standard transformer
+    # trade (much better MFU, modestly more memory); "none" disables.
+    remat_policy: str = "dots"
+    scan_layers: bool = True
+    sp_axis: str = "sp"
+
+    @property
+    def mlp_dim(self) -> int:
+        return self.d_mlp or 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    def num_params(self) -> int:
+        wpe = self.max_seq * self.d_model
+        wte = self.vocab_size * self.d_model
+        per_layer = (
+            4 * self.d_model * self.d_model  # qkv + proj
+            + 2 * self.d_model * self.mlp_dim  # mlp in/out
+            + 2 * self.d_model * 2  # lns
+            + 4 * self.d_model + self.mlp_dim + self.d_model  # biases(ish)
+        )
+        return wte + wpe + self.num_layers * per_layer + 2 * self.d_model
+
+
+# Published GPT-2 sizes (vocab padded for lane alignment).
+CONFIGS: Dict[str, GPT2Config] = {
+    "gpt2-124m": GPT2Config(num_layers=12, num_heads=12, d_model=768),
+    "gpt2-355m": GPT2Config(num_layers=24, num_heads=16, d_model=1024),
+    "gpt2-774m": GPT2Config(num_layers=36, num_heads=20, d_model=1280),
+    "gpt2-1.5b": GPT2Config(num_layers=48, num_heads=25, d_model=1600),
+}
+
+
+def init_params(key, cfg: GPT2Config) -> Tuple[Dict, Dict]:
+    """Returns (params, logical_axes) pytrees with identical structure."""
+    keys = jax.random.split(key, 8)
+    d, h, m = cfg.d_model, cfg.num_heads, cfg.mlp_dim
+    L = cfg.num_layers
+    proj_std = 0.02 / math.sqrt(2 * L)
+
+    def layer_init(k):
+        ks = jax.random.split(k, 4)
+        return {
+            "ln1_scale": jnp.ones((L, d)),
+            "ln1_bias": jnp.zeros((L, d)),
+            "qkv_w": truncated_normal(ks[0], (L, d, 3 * d)),
+            "qkv_b": jnp.zeros((L, 3 * d)),
+            "proj_w": truncated_normal(ks[1], (L, d, d), stddev=proj_std),
+            "proj_b": jnp.zeros((L, d)),
+            "ln2_scale": jnp.ones((L, d)),
+            "ln2_bias": jnp.zeros((L, d)),
+            "mlp_in_w": truncated_normal(ks[2], (L, d, m)),
+            "mlp_in_b": jnp.zeros((L, m)),
+            "mlp_out_w": truncated_normal(ks[3], (L, m, d), stddev=proj_std),
+            "mlp_out_b": jnp.zeros((L, d)),
+        }
+
+    params = {
+        "wte": truncated_normal(keys[0], (cfg.vocab_size, d)),
+        "wpe": truncated_normal(keys[1], (cfg.max_seq, d), stddev=0.01),
+        "blocks": layer_init(keys[2]),
+        "lnf_scale": jnp.ones((d,)),
+        "lnf_bias": jnp.zeros((d,)),
+    }
+    axes = {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "blocks": {
+            "ln1_scale": ("layers", None),
+            "ln1_bias": ("layers", None),
+            "qkv_w": ("layers", "embed", "qkv"),
+            "qkv_b": ("layers", "qkv"),
+            "proj_w": ("layers", "qkv", "embed"),
+            "proj_b": ("layers", "embed"),
+            "ln2_scale": ("layers", None),
+            "ln2_bias": ("layers", None),
+            "mlp_in_w": ("layers", "embed", "mlp"),
+            "mlp_in_b": ("layers", "mlp"),
+            "mlp_out_w": ("layers", "mlp", "embed"),
+            "mlp_out_b": ("layers", "embed"),
+        },
+        "lnf_scale": (None,),
+        "lnf_bias": (None,),
+    }
+    return params, axes
+
+
+def _attend(q, k, v, cfg: GPT2Config, rules):
+    impl = cfg.attention_impl
+    if impl in ("auto", "flash", "reference"):
+        return attention_op(q, k, v, causal=True, impl=impl)
+    # Sequence-parallel impls: nest a shard_map over the ambient mesh so the
+    # GSPMD program hands locally-sharded blocks to the ring/a2a body.
+    from functools import partial as _partial
+
+    from ..parallel.sharding import current_mesh, smap, spec_for
+
+    mesh = current_mesh()
+    if mesh is None:
+        raise RuntimeError(
+            f"attention_impl={impl!r} needs an ambient mesh "
+            "(run via build_sharded_train or set_current_mesh)"
+        )
+    spec = spec_for(("batch", "heads", "seq", None), rules)
+    if impl == "ring":
+        from ..parallel.ring import ring_attention_local
+
+        body = _partial(ring_attention_local, axis_name=cfg.sp_axis)
+    elif impl == "ulysses":
+        from ..parallel.ulysses import ulysses_attention_local
+
+        body = _partial(ulysses_attention_local, axis_name=cfg.sp_axis)
+    else:
+        raise ValueError(f"unknown attention_impl {impl!r}")
+    fn = smap(body, mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def _block(x, p, cfg: GPT2Config, rules):
+    """One transformer block. x: [B, S, D]; p: this layer's param slice."""
+    b, s, d = x.shape
+    h, hd = cfg.num_heads, cfg.head_dim
+
+    y = layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+    qkv = (y @ p["qkv_w"].astype(y.dtype)) + p["qkv_b"].astype(y.dtype)
+    qkv = constrain(qkv, ("batch", "seq", "qkv"), rules)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):  # [B,S,D] -> [B,H,S,hd]
+        return t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+
+    o = _attend(heads(q), heads(k), heads(v), cfg, rules)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+    o = (o @ p["proj_w"].astype(o.dtype)) + p["proj_b"].astype(o.dtype)
+    x = x + constrain(o, ("batch", "seq", None), rules)
+
+    y = layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    hdn = (y @ p["mlp_in_w"].astype(y.dtype)) + p["mlp_in_b"].astype(y.dtype)
+    hdn = constrain(hdn, ("batch", "seq", "mlp"), rules)
+    hdn = jax.nn.gelu(hdn, approximate=True)
+    out = (hdn @ p["mlp_out_w"].astype(hdn.dtype)) + p["mlp_out_b"].astype(
+        hdn.dtype
+    )
+    return x + constrain(out, ("batch", "seq", None), rules)
+
+
+def forward(params, tokens, cfg: GPT2Config, rules=None):
+    """tokens [B, S] -> logits [B, S, vocab]."""
+    b, s = tokens.shape
+    x = params["wte"][tokens].astype(cfg.dtype)
+    x = x + params["wpe"][:s].astype(cfg.dtype)[None]
+    x = constrain(x, ("batch", "seq", None), rules)
+
+    block = partial(_block, cfg=cfg, rules=rules)
+    if cfg.remat and cfg.remat_policy != "none":
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            block = jax.checkpoint(block, policy=policy)
+        else:
+            block = jax.checkpoint(block)
+
+    if cfg.scan_layers:
+        def scan_body(x, layer_params):
+            return block(x, layer_params), None
+
+        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    else:
+        for i in range(cfg.num_layers):
+            layer = jax.tree.map(lambda a: a[i], params["blocks"])
+            x = block(x, layer)
+
+    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    # Tied LM head (fp32 logits for a stable loss).
+    logits = jnp.einsum(
+        "bsd,vd->bsv", x, params["wte"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return constrain(logits, ("batch", "seq", "vocab"), rules)
+
+
+def loss_fn(params, batch, cfg: GPT2Config, rules=None):
+    """batch: {"tokens": [B, S+1]} → next-token CE loss."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, cfg, rules)
+    loss, _ = cross_entropy_loss(logits, targets)
+    return loss
+
+
+def flops_per_token(cfg: GPT2Config, seq: int) -> float:
+    """Training FLOPs/token: 6N + attention term (PaLM appendix formula)."""
+    n = cfg.num_params() - cfg.vocab_size * cfg.d_model * 0  # full params
+    attn = 12 * cfg.num_layers * cfg.d_model * seq
+    return 6.0 * n + attn

@@ -78,8 +78,23 @@ def shardings_for(mesh: Mesh, logical_tree: Any,
 
 def constrain(x, logical_axes: Sequence[Optional[str]],
               rules: Optional[Rules] = None):
-    """``with_sharding_constraint`` by logical axis names (inside jit)."""
-    return jax.lax.with_sharding_constraint(x, spec_for(logical_axes, rules))
+    """``with_sharding_constraint`` by logical axis names (inside jit).
+
+    No-op when there is no ambient mesh (single-device jit, driver compile
+    checks): model code stays mesh-agnostic.
+    """
+    spec = spec_for(logical_axes, rules)
+    if not len(spec):
+        return x
+    mesh = current_mesh()
+    if mesh is None:
+        try:
+            ambient = jax.sharding.get_abstract_mesh()
+            if ambient is None or ambient.empty:
+                return x
+        except Exception:
+            return x
+    return jax.lax.with_sharding_constraint(x, spec)
 
 
 def prune_rules_for_mesh(mesh: Mesh, rules: Optional[Rules] = None) -> Rules:
@@ -108,6 +123,17 @@ def place(mesh: Mesh, tree: Any, logical_tree: Any,
     """Device-put a pytree onto the mesh under the rule table."""
     shardings = shardings_for(mesh, logical_tree, rules)
     return jax.device_put(tree, shardings)
+
+
+_CURRENT_MESH: list = [None]
+
+
+def set_current_mesh(mesh: Optional[Mesh]) -> None:
+    _CURRENT_MESH[0] = mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _CURRENT_MESH[0]
 
 
 def smap(f, mesh: Mesh, in_specs, out_specs):

@@ -1,0 +1,20 @@
+"""Train library: distributed training on mesh-aware actor gangs.
+
+Reference analog: ``python/ray/train`` + the AIR session/config/checkpoint
+surface (``python/ray/air``).
+"""
+
+from . import session
+from .checkpoint import Checkpoint, CheckpointManager, restore_arrays, save_arrays
+from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .step import build_sharded_train, default_optimizer, make_eval_step
+from .trainer import BackendExecutor, DataParallelTrainer, JaxTrainer, Result
+from .worker_group import WorkerGroup
+
+__all__ = [
+    "BackendExecutor", "Checkpoint", "CheckpointConfig", "CheckpointManager",
+    "DataParallelTrainer", "FailureConfig", "JaxTrainer", "Result",
+    "RunConfig", "ScalingConfig", "WorkerGroup", "build_sharded_train",
+    "default_optimizer", "make_eval_step", "restore_arrays", "save_arrays",
+    "session",
+]

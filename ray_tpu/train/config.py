@@ -1,0 +1,68 @@
+"""Train/AIR-style configuration dataclasses.
+
+Reference analog: ``python/ray/air/config.py`` — ``ScalingConfig`` (:79),
+``RunConfig`` (:452 area), ``FailureConfig``, ``CheckpointConfig`` (:511) —
+re-based on TPU concepts: a ScalingConfig names a mesh layout (MeshSpec) and
+a worker count, where workers are *hosts* joining one SPMD program rather
+than NCCL ranks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ..parallel.mesh import MeshSpec
+
+
+@dataclass
+class ScalingConfig:
+    """How a trainer scales over the cluster.
+
+    num_workers: host processes joining the SPMD program (reference:
+      train workers). Single-host multi-chip runs use num_workers=1 and let
+      the mesh span local chips.
+    mesh: parallelism layout over all chips the job claims.
+    resources_per_worker: scheduler resources per worker actor.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    mesh: Optional[MeshSpec] = None
+    resources_per_worker: Dict[str, float] = field(default_factory=dict)
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker)
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res.setdefault("TPU", 1.0)
+        return res
+
+
+@dataclass
+class FailureConfig:
+    """Reference: air/config.py FailureConfig — trial-level retries."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Reference: air/config.py:511 — keep-N + score-based retention."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = True
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    stop: Optional[Dict[str, Any]] = None
+    verbose: int = 1

@@ -1,0 +1,231 @@
+"""Trainers: user-facing Train API.
+
+Reference analog:
+  - ``train/base_trainer.py:339`` ``BaseTrainer.fit`` (+ ``as_trainable``
+    :365 so every Train job runs as a Tune trial);
+  - ``train/data_parallel_trainer.py:320`` ``training_loop`` driving
+    ``BackendExecutor`` (``train/_internal/backend_executor.py:42,93,275``)
+    which starts a WorkerGroup and runs the user ``train_func`` per worker.
+
+TPU re-design: ``JaxTrainer`` replaces the torch/tf/horovod Backend plugins —
+there is no process-group setup step; workers join a mesh (on one host the
+mesh is local; multi-host workers call ``jax.distributed.initialize`` with a
+coordinator from the control store). The user train_func uses
+``session.report`` exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .worker_group import WorkerGroup
+
+
+@dataclass
+class Result:
+    """Reference analog: ``air.result.Result`` / ``ResultGrid`` entry."""
+
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[str] = None
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+    path: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+class BackendExecutor:
+    """Starts the worker gang and drives the user train loop.
+
+    Reference: ``backend_executor.py`` — ``start`` (:93) creates the
+    WorkerGroup, ``start_training`` (:275) launches train_func per worker
+    with rank env, results polled from per-worker sessions.
+    """
+
+    def __init__(self, scaling: ScalingConfig, env: Optional[dict] = None):
+        self.scaling = scaling
+        self.env = env
+        self.worker_group: Optional[WorkerGroup] = None
+
+    def start(self) -> None:
+        self.worker_group = WorkerGroup(
+            self.scaling.num_workers,
+            resources_per_worker=self.scaling.worker_resources(),
+            placement_strategy=self.scaling.placement_strategy,
+            env=self.env,
+        )
+
+    def run(self, train_fn: Callable, config: Optional[Dict],
+            on_report: Optional[Callable] = None,
+            poll_interval: float = 0.2,
+            loaded_checkpoint: Optional[Checkpoint] = None) -> List[Any]:
+        assert self.worker_group is not None, "call start() first"
+        if loaded_checkpoint is not None:
+            self.worker_group.setup_sessions(
+                loaded_checkpoint=loaded_checkpoint
+            )
+        from ..core import wait
+
+        done_refs = self.worker_group.run_train_fns(train_fn, config)
+        pending = list(done_refs)
+        while pending:
+            ready, pending = wait(pending, num_returns=len(pending),
+                                  timeout=poll_interval)
+            for batch in self.worker_group.drain_results():
+                for metrics, ckpt in batch:
+                    if on_report is not None:
+                        on_report(metrics, ckpt)
+        from ..core import get
+
+        outcomes = get(done_refs)
+        # Final drain after completion.
+        for batch in self.worker_group.drain_results():
+            for metrics, ckpt in batch:
+                if on_report is not None:
+                    on_report(metrics, ckpt)
+        return outcomes
+
+    def shutdown(self) -> None:
+        if self.worker_group is not None:
+            self.worker_group.shutdown()
+            self.worker_group = None
+
+
+class DataParallelTrainer:
+    """Run ``train_loop_per_worker`` on N workers; aggregate rank-0 reports.
+
+    Reference: ``DataParallelTrainer`` — the framework-specific Backend
+    plugins collapse into plain JAX (no process-group glue needed).
+    """
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self._train_fn = train_loop_per_worker
+        self._config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self._datasets = datasets or {}
+        self._resume_from = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        import os
+        import tempfile
+
+        from ..core import runtime as runtime_mod
+
+        runtime_mod.auto_init()
+        name = self.run_config.name or f"train-{uuid.uuid4().hex[:8]}"
+        storage = self.run_config.storage_path or os.path.join(
+            tempfile.gettempdir(), "rt_results"
+        )
+        trial_dir = os.path.join(storage, name)
+        ckpt_cfg = self.run_config.checkpoint_config
+        manager = CheckpointManager(
+            os.path.join(trial_dir, "checkpoints"),
+            num_to_keep=ckpt_cfg.num_to_keep,
+            score_attribute=ckpt_cfg.checkpoint_score_attribute,
+            score_order=ckpt_cfg.checkpoint_score_order,
+        )
+        history: List[Dict] = []
+        latest_ckpt: List[Optional[Checkpoint]] = [self._resume_from]
+        step_counter = [0]
+
+        def on_report(metrics: Dict, ckpt: Optional[Checkpoint]):
+            history.append(metrics)
+            if ckpt is not None:
+                step_counter[0] += 1
+                manager.save(ckpt, step_counter[0], metrics)
+                latest_ckpt[0] = ckpt
+
+        executor = BackendExecutor(self.scaling_config)
+        failures_left = self.run_config.failure_config.max_failures
+        while True:
+            executor.start()
+            if self._datasets:
+                shards = self._shard_datasets(executor.worker_group)
+                for rank, worker_shards in enumerate(shards):
+                    executor.worker_group.workers[rank].setup_session.remote(
+                        dataset_shards=worker_shards
+                    )
+            try:
+                outcomes = executor.run(
+                    self._train_fn, self._config, on_report=on_report,
+                    loaded_checkpoint=latest_ckpt[0],
+                )
+            except Exception as e:  # noqa: BLE001 — worker gang crashed
+                executor.shutdown()
+                if failures_left != 0:
+                    failures_left -= 1
+                    continue  # restart from latest checkpoint
+                return Result(metrics=history[-1] if history else {},
+                              checkpoint=latest_ckpt[0], error=str(e),
+                              metrics_history=history, path=trial_dir)
+            executor.shutdown()
+            errors = [o[1] for o in outcomes if o[0] == "error"]
+            if errors and failures_left != 0:
+                failures_left -= 1
+                continue
+            return Result(
+                metrics=history[-1] if history else {},
+                checkpoint=latest_ckpt[0],
+                error=errors[0] if errors else None,
+                metrics_history=history,
+                path=trial_dir,
+            )
+
+    def _shard_datasets(self, worker_group) -> List[Dict[str, Any]]:
+        """Split datasets across workers (reference: dataset_spec
+        get_dataset_shards)."""
+        n = len(worker_group)
+        out: List[Dict[str, Any]] = [dict() for _ in range(n)]
+        for name, ds in self._datasets.items():
+            if hasattr(ds, "split"):
+                shards = ds.split(n)
+            else:
+                shards = [ds] * n
+            for rank in range(n):
+                out[rank][name] = shards[rank]
+        return out
+
+    def as_trainable(self):
+        """Adapt for the Tune layer (reference: base_trainer.py:365)."""
+        trainer = self
+
+        def trainable(config: Dict):
+            from . import session as tune_session
+
+            merged = dict(trainer._config or {})
+            merged.update(config)
+            t = DataParallelTrainer(
+                trainer._train_fn,
+                train_loop_config=merged,
+                scaling_config=trainer.scaling_config,
+                run_config=trainer.run_config,
+                datasets=trainer._datasets,
+            )
+            result = t.fit()
+            s = tune_session.get_session()
+            if s is not None and result.metrics:
+                s.report(result.metrics, result.checkpoint)
+            return result.metrics
+
+        return trainable
+
+
+class JaxTrainer(DataParallelTrainer):
+    """Alias emphasizing the native backend (reference's TorchTrainer slot)."""

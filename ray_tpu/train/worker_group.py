@@ -1,0 +1,141 @@
+"""WorkerGroup: a gang of actor processes forming one SPMD program.
+
+Reference analog: ``python/ray/train/_internal/worker_group.py:91,334`` — N
+actors in a placement group, ``execute()`` runs a function on all workers
+simultaneously. This is the "mesh actor-group" primitive of SURVEY §7.3:
+methods are SPMD entry points executed on every member host; on real pods
+each worker process owns its host's chips and joins the global mesh via
+``jax.distributed`` (coordinator address handed out by the control store —
+replacing torch's ``init_process_group`` rendezvous,
+``train/torch/config.py:69``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core import get, placement_group, remote, remove_placement_group
+from ..core.placement_group import PlacementGroupSchedulingStrategy
+
+
+class _TrainWorker:
+    """Actor body: hosts the session and executes arbitrary fns."""
+
+    def __init__(self, world_rank: int, world_size: int, env: Optional[dict]):
+        import os
+
+        os.environ.update(env or {})
+        from .session import SessionContext, init_session
+
+        self.ctx = SessionContext(world_rank=world_rank,
+                                  world_size=world_size,
+                                  local_rank=world_rank)
+        init_session(self.ctx)
+        self._train_result = None
+        self._train_error = None
+
+    def setup_session(self, **ctx_updates):
+        for k, v in ctx_updates.items():
+            setattr(self.ctx, k, v)
+        return True
+
+    def execute(self, fn, *args, **kwargs):
+        return fn(*args, **kwargs)
+
+    def run_train_fn(self, train_fn, config):
+        """Run the user train loop to completion (blocking actor method)."""
+        from .session import get_session
+
+        try:
+            import inspect
+
+            sig = inspect.signature(train_fn)
+            if len(sig.parameters) >= 1:
+                result = train_fn(config if config is not None else {})
+            else:
+                result = train_fn()
+            self._train_result = result
+            return ("ok", result)
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            self._train_error = traceback.format_exc()
+            return ("error", f"{e}\n{self._train_error}")
+
+    def drain_results(self):
+        from .session import get_session
+
+        s = get_session()
+        return s.drain() if s else []
+
+    def get_context(self):
+        return {
+            "world_rank": self.ctx.world_rank,
+            "world_size": self.ctx.world_size,
+        }
+
+
+class WorkerGroup:
+    """N train-worker actors in a placement group."""
+
+    def __init__(self, num_workers: int,
+                 resources_per_worker: Optional[Dict[str, float]] = None,
+                 placement_strategy: str = "PACK",
+                 env: Optional[dict] = None):
+        self.num_workers = num_workers
+        resources = dict(resources_per_worker or {"CPU": 1.0})
+        bundles = [dict(resources) for _ in range(num_workers)]
+        self._pg = placement_group(bundles, strategy=placement_strategy)
+        if not self._pg.wait(60):
+            remove_placement_group(self._pg)
+            raise RuntimeError(
+                f"could not reserve {num_workers}x{resources} for WorkerGroup"
+            )
+        worker_cls = remote(_TrainWorker)
+        self.workers = []
+        for rank in range(num_workers):
+            actor = worker_cls.options(
+                num_cpus=resources.get("CPU", 1.0),
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=self._pg,
+                    placement_group_bundle_index=rank,
+                ),
+            ).remote(rank, num_workers, env)
+            self.workers.append(actor)
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        """Run ``fn`` on every worker simultaneously; gather results.
+
+        Reference: WorkerGroup.execute (worker_group.py:225-287).
+        """
+        refs = [w.execute.remote(fn, *args, **kwargs) for w in self.workers]
+        return get(refs)
+
+    def execute_async(self, fn: Callable, *args, **kwargs):
+        return [w.execute.remote(fn, *args, **kwargs) for w in self.workers]
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs):
+        return get(self.workers[rank].execute.remote(fn, *args, **kwargs))
+
+    def run_train_fns(self, train_fn: Callable, config):
+        """Kick off the user train loop on all workers (non-blocking)."""
+        return [w.run_train_fn.remote(train_fn, config) for w in self.workers]
+
+    def drain_results(self) -> List[List]:
+        return get([w.drain_results.remote() for w in self.workers])
+
+    def setup_sessions(self, **ctx_updates) -> None:
+        get([w.setup_session.remote(**ctx_updates) for w in self.workers])
+
+    def shutdown(self) -> None:
+        from ..core import kill
+
+        for w in self.workers:
+            try:
+                kill(w)
+            except Exception:
+                pass
+        remove_placement_group(self._pg)
+
+    def __len__(self):
+        return self.num_workers
