@@ -88,6 +88,7 @@ class _ActorRecord:
     seq: int = 0
     methods: Dict[str, dict] = field(default_factory=dict)
     creation_pins_released: bool = False
+    resources_released: bool = False
 
 
 class Runtime:
@@ -623,6 +624,7 @@ class Runtime:
             worker = record.worker
         if worker is not None:
             worker.kill()  # ctor failed: reap the dedicated worker
+        self._release_actor_resources(record)
         self.gcs.update_actor(record.actor_id, ActorState.DEAD,
                               death_cause=str(error))
         for oid in record.creation_spec.return_ids():
@@ -717,8 +719,23 @@ class Runtime:
             for oid in spec.return_ids():
                 self._mark_failed(oid, ActorDiedError(
                     actor_id, "actor terminated (handle out of scope)"))
+        self._release_actor_resources(record)
         if worker is not None:
             worker.send(("drain_exit",))
+
+    def _release_actor_resources(self, record: _ActorRecord) -> None:
+        """Return the actor's reserved resources once it is DEAD for good.
+
+        Reference: raylet releases an actor worker's resources on death.
+        """
+        with self._lock:
+            if record.resources_released or record.node is None:
+                return
+            record.resources_released = True
+            node, spec = record.node, record.creation_spec
+        if spec.strategy.kind != "PLACEMENT_GROUP":
+            node.ledger.release(spec.resources)
+        self.scheduler.notify()
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         with self._lock:
@@ -982,6 +999,7 @@ class Runtime:
         else:
             self.gcs.update_actor(record.actor_id, ActorState.DEAD,
                                   death_cause="worker died")
+            self._release_actor_resources(record)
             with self._lock:
                 pending = list(record.pending)
                 record.pending = []

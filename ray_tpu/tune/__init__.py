@@ -1,0 +1,45 @@
+"""Tune library: experiment execution, search, schedulers.
+
+Reference analog: ``python/ray/tune``.
+"""
+
+from .schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialDecision,
+    TrialScheduler,
+)
+from .search import (
+    BasicVariantGenerator,
+    Choice,
+    Domain,
+    GridSearch,
+    RandomSearch,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from .tuner import (
+    ResultGrid,
+    Trial,
+    TrialRunner,
+    TrialStatus,
+    TuneConfig,
+    Tuner,
+    report,
+    run,
+)
+
+__all__ = [
+    "AsyncHyperBandScheduler", "BasicVariantGenerator", "Choice", "Domain",
+    "FIFOScheduler", "GridSearch", "MedianStoppingRule",
+    "PopulationBasedTraining", "RandomSearch", "ResultGrid", "Searcher",
+    "Trial", "TrialDecision", "TrialRunner", "TrialScheduler", "TrialStatus",
+    "TuneConfig", "Tuner", "choice", "grid_search", "loguniform", "randint",
+    "report", "run", "uniform",
+]

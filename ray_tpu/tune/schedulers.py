@@ -1,0 +1,216 @@
+"""Trial schedulers: FIFO, ASHA, HyperBand-lite, PBT, median stopping.
+
+Reference analog: ``python/ray/tune/schedulers/`` —
+``async_hyperband.py`` (ASHA), ``pbt.py:130`` (PopulationBasedTraining with
+``_exploit`` :607), ``median_stopping_rule.py``. Decision protocol mirrors
+the reference: schedulers see each intermediate result and answer
+CONTINUE / STOP / (PBT) EXPLOIT.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class TrialDecision:
+    CONTINUE = "CONTINUE"
+    STOP = "STOP"
+    EXPLOIT = "EXPLOIT"  # PBT: clone weights+config from a better trial
+
+
+class TrialScheduler:
+    def on_result(self, trial, result: Dict) -> str:
+        return TrialDecision.CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict]) -> None:
+        pass
+
+    def choose_exploit_source(self, trial, trials) -> Optional[Any]:
+        return None
+
+    def mutate_config(self, config: Dict) -> Dict:
+        return config
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion (reference: fifo.py)."""
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA: asynchronous successive halving.
+
+    Reference: ``schedulers/async_hyperband.py`` — rungs at
+    grace_period * reduction_factor^k; a trial reaching a rung is stopped
+    unless its metric is in the top 1/reduction_factor of results recorded
+    at that rung.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: float = 3,
+                 max_t: int = 100):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self.rungs: List[float] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t = int(math.ceil(t * reduction_factor))
+        # rung milestone -> recorded metric values
+        self._recorded: Dict[float, List[float]] = defaultdict(list)
+
+    def on_result(self, trial, result: Dict) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return TrialDecision.CONTINUE
+        if t >= self.max_t:
+            return TrialDecision.STOP
+        decision = TrialDecision.CONTINUE
+        for rung in self.rungs:
+            if t == rung or (t > rung and not trial.rungs_passed.get(rung)):
+                trial.rungs_passed[rung] = True
+                recorded = self._recorded[rung]
+                recorded.append(value)
+                if len(recorded) >= self.rf:
+                    cutoff = self._cutoff(recorded)
+                    bad = (value > cutoff if self.mode == "min"
+                           else value < cutoff)
+                    if bad:
+                        decision = TrialDecision.STOP
+        return decision
+
+    def _cutoff(self, recorded: List[float]) -> float:
+        k = max(1, int(len(recorded) / self.rf))
+        ordered = sorted(recorded, reverse=(self.mode == "max"))
+        return ordered[k - 1]
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop trials whose running mean is worse than the median of others.
+
+    Reference: ``schedulers/median_stopping_rule.py``.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._means: Dict[str, float] = {}
+        self._counts: Dict[str, int] = defaultdict(int)
+
+    def on_result(self, trial, result: Dict) -> str:
+        value = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if value is None:
+            return TrialDecision.CONTINUE
+        n = self._counts[trial.trial_id] + 1
+        self._counts[trial.trial_id] = n
+        prev = self._means.get(trial.trial_id, 0.0)
+        self._means[trial.trial_id] = prev + (value - prev) / n
+        if t < self.grace or len(self._means) < self.min_samples:
+            return TrialDecision.CONTINUE
+        others = [m for tid, m in self._means.items()
+                  if tid != trial.trial_id]
+        if not others:
+            return TrialDecision.CONTINUE
+        med = sorted(others)[len(others) // 2]
+        mine = self._means[trial.trial_id]
+        worse = mine > med if self.mode == "min" else mine < med
+        return TrialDecision.STOP if worse else TrialDecision.CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: periodically exploit better trials + explore their config.
+
+    Reference: ``schedulers/pbt.py:130`` — every ``perturbation_interval``
+    a bottom-quantile trial copies a top-quantile trial's checkpoint and
+    perturbs hyperparameters (x1.2 / x0.8 or resample).
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self.rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = defaultdict(int)
+        self._scores: Dict[str, float] = {}
+
+    def on_result(self, trial, result: Dict) -> str:
+        value = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if value is None:
+            return TrialDecision.CONTINUE
+        self._scores[trial.trial_id] = value
+        if t - self._last_perturb[trial.trial_id] < self.interval:
+            return TrialDecision.CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        if len(self._scores) < 2:
+            return TrialDecision.CONTINUE
+        ordered = sorted(
+            self._scores.items(), key=lambda kv: kv[1],
+            reverse=(self.mode == "max"),
+        )
+        n = len(ordered)
+        k = max(1, int(n * self.quantile))
+        bottom_ids = {tid for tid, _ in ordered[-k:]}
+        if trial.trial_id in bottom_ids and n > k:
+            return TrialDecision.EXPLOIT
+        return TrialDecision.CONTINUE
+
+    def choose_exploit_source(self, trial, trials):
+        ordered = sorted(
+            (t for t in trials if t.trial_id in self._scores
+             and t.trial_id != trial.trial_id),
+            key=lambda t: self._scores[t.trial_id],
+            reverse=(self.mode == "max"),
+        )
+        if not ordered:
+            return None
+        k = max(1, int(len(ordered) * self.quantile))
+        return self.rng.choice(ordered[:k])
+
+    def mutate_config(self, config: Dict) -> Dict:
+        """Reference: pbt.py _explore — perturb or resample each mutable."""
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if key not in out:
+                continue
+            if self.rng.random() < self.resample_prob or not isinstance(
+                out[key], (int, float)
+            ):
+                if isinstance(spec, list):
+                    out[key] = self.rng.choice(spec)
+                elif callable(spec):
+                    out[key] = spec()
+                elif hasattr(spec, "sample"):
+                    out[key] = spec.sample(self.rng)
+            else:
+                factor = 1.2 if self.rng.random() > 0.5 else 0.8
+                out[key] = out[key] * factor
+                if isinstance(config[key], int):
+                    out[key] = max(1, int(out[key]))
+        return out

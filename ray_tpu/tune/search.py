@@ -1,0 +1,150 @@
+"""Search spaces and search algorithms.
+
+Reference analog: ``python/ray/tune/search/`` — the sampling primitives
+(``tune.uniform/loguniform/choice/grid_search``) and
+``basic_variant.py``/``variant_generator.py`` (grid expansion + random
+sampling). External searcher integrations (hyperopt/optuna/...) plug in via
+the same ``Searcher`` interface.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+# -- sampling primitives (tune.* search space API) ---------------------------
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclass
+class RandInt(Domain):
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class Choice(Domain):
+    categories: List[Any]
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+@dataclass
+class GridSearch:
+    values: List[Any]
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(categories: List[Any]) -> Choice:
+    return Choice(list(categories))
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(list(values))
+
+
+# -- searchers ---------------------------------------------------------------
+
+class Searcher:
+    """Suggest configs; receive completed-trial feedback.
+
+    Reference: ``tune/search/searcher.py`` Searcher interface.
+    """
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict],
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid expansion x num_samples random sampling.
+
+    Reference: ``tune/search/basic_variant.py`` — every grid_search key is
+    fully expanded; Domain leaves are sampled per variant; the whole space
+    repeats ``num_samples`` times.
+    """
+
+    def __init__(self, space: Dict, num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self.space = space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._variants = self._expand()
+        self._index = 0
+
+    def _expand(self) -> List[Dict]:
+        grid_keys = [k for k, v in self.space.items()
+                     if isinstance(v, GridSearch)]
+        grids = [self.space[k].values for k in grid_keys]
+        variants = []
+        for _ in range(self.num_samples):
+            for combo in itertools.product(*grids) if grids else [()]:
+                cfg = {}
+                for k, v in self.space.items():
+                    if isinstance(v, GridSearch):
+                        cfg[k] = combo[grid_keys.index(k)]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self.rng)
+                    elif callable(v) and not isinstance(v, type):
+                        cfg[k] = v()  # tune.sample_from style
+                    else:
+                        cfg[k] = v
+                variants.append(cfg)
+        return variants
+
+    def total(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if self._index >= len(self._variants):
+            return None
+        cfg = self._variants[self._index]
+        self._index += 1
+        return cfg
+
+
+class RandomSearch(BasicVariantGenerator):
+    """Pure random sampling (no grid keys required)."""

@@ -1,0 +1,351 @@
+"""Experiment execution: Trial, TrialRunner, Tuner, ResultGrid.
+
+Reference analog:
+  - ``tune/tuner.py:40,220`` ``Tuner.fit`` → ``tune/impl/tuner_internal.py``
+    → ``tune/tune.py:129`` ``tune.run``
+  - ``tune/execution/trial_runner.py:236,864`` — the step loop driving
+    trial actors, consuming intermediate results, applying scheduler
+    decisions, handling failures
+  - ``tune/trainable/function_trainable.py:277`` — user functions report
+    via the session; here trials are actors hosting the user fn in a
+    background thread, drained by the runner (same shape, no queue thread).
+
+PBT exploit = stop the trial actor, mutate config, restart from the source
+trial's checkpoint (reference: pbt.py _exploit :607).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core import get, kill, remote, wait
+from ..train.checkpoint import Checkpoint
+from ..train.config import FailureConfig, RunConfig
+from .schedulers import FIFOScheduler, TrialDecision, TrialScheduler
+from .search import BasicVariantGenerator, Searcher
+
+
+class TrialStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    TERMINATED = "TERMINATED"
+    STOPPED = "STOPPED"
+    ERROR = "ERROR"
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict
+    status: str = TrialStatus.PENDING
+    results: List[Dict] = field(default_factory=list)
+    last_result: Dict = field(default_factory=dict)
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[str] = None
+    iteration: int = 0
+    rungs_passed: Dict = field(default_factory=dict)
+    failures: int = 0
+    actor: Any = None
+    done_ref: Any = None
+
+
+class _TrialActor:
+    """Hosts one trial's user function in a background thread."""
+
+    def __init__(self):
+        import threading
+
+        self._thread: Optional[threading.Thread] = None
+        self._done = False
+        self._error: Optional[str] = None
+        self._stop_requested = False
+
+    def start(self, fn, config, checkpoint=None, trial_id: str = ""):
+        import threading
+
+        from ray_tpu.train.session import SessionContext, init_session
+
+        session = init_session(SessionContext(
+            trial_id=trial_id, loaded_checkpoint=checkpoint,
+        ))
+
+        def run():
+            try:
+                fn(config)
+            except SystemExit:
+                pass
+            except Exception:  # noqa: BLE001
+                import traceback
+
+                self._error = traceback.format_exc()
+            finally:
+                self._done = True
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return True
+
+    def drain(self):
+        from ray_tpu.train.session import get_session
+
+        s = get_session()
+        out = s.drain() if s else []
+        return out, self._done, self._error
+
+    def request_stop(self):
+        self._stop_requested = True
+        return True
+
+
+@dataclass
+class ResultGrid:
+    """Reference analog: ``tune/result_grid.py``."""
+
+    trials: List[Trial]
+
+    def get_best_result(self, metric: str, mode: str = "min") -> Trial:
+        scored = [t for t in self.trials if metric in t.last_result]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return sorted(
+            scored, key=lambda t: t.last_result[metric],
+            reverse=(mode == "max"),
+        )[0]
+
+    def get_dataframe(self):
+        rows = []
+        for t in self.trials:
+            row = {"trial_id": t.trial_id, "status": t.status}
+            row.update({f"config/{k}": v for k, v in t.config.items()})
+            row.update(t.last_result)
+            rows.append(row)
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(rows)
+        except ImportError:
+            return rows
+
+    @property
+    def errors(self) -> List[str]:
+        return [t.error for t in self.trials if t.error]
+
+
+class TrialRunner:
+    """The experiment step loop (trial_runner.py:864)."""
+
+    def __init__(self, trainable: Callable, searcher: Searcher,
+                 scheduler: Optional[TrialScheduler] = None,
+                 max_concurrent: int = 4,
+                 max_failures: int = 0,
+                 stop: Optional[Dict[str, Any]] = None,
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 poll_interval: float = 0.05):
+        self.trainable = trainable
+        self.searcher = searcher
+        self.scheduler = scheduler or FIFOScheduler()
+        self.max_concurrent = max_concurrent
+        self.max_failures = max_failures
+        self.stop_criteria = stop or {}
+        self.resources = resources_per_trial or {"CPU": 1.0}
+        self.poll_interval = poll_interval
+        self.trials: List[Trial] = []
+        self._actor_cls = remote(_TrialActor)
+
+    # -- lifecycle -----------------------------------------------------------
+    def _launch(self, trial: Trial,
+                checkpoint: Optional[Checkpoint] = None) -> None:
+        actor = self._actor_cls.options(
+            num_cpus=self.resources.get("CPU", 1.0),
+            resources={k: v for k, v in self.resources.items()
+                       if k != "CPU"} or None,
+        ).remote()
+        trial.actor = actor
+        trial.done_ref = actor.start.remote(
+            self.trainable, trial.config,
+            checkpoint or trial.checkpoint, trial.trial_id,
+        )
+        trial.status = TrialStatus.RUNNING
+
+    def _stop_trial(self, trial: Trial, status: str) -> None:
+        trial.status = status
+        if trial.actor is not None:
+            try:
+                kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+
+    # -- the loop ------------------------------------------------------------
+    def run(self) -> ResultGrid:
+        while True:
+            self._maybe_start_trials()
+            running = [t for t in self.trials
+                       if t.status == TrialStatus.RUNNING]
+            if not running and not self._more_trials_possible():
+                break
+            for trial in running:
+                self._poll_trial(trial)
+            time.sleep(self.poll_interval)
+        return ResultGrid(self.trials)
+
+    def _more_trials_possible(self) -> bool:
+        probe = self.searcher.suggest("__peek__") if hasattr(
+            self.searcher, "_variants"
+        ) else None
+        if probe is not None:
+            # un-consume: re-insert at front
+            self.searcher._index -= 1  # type: ignore[attr-defined]
+            return True
+        return False
+
+    def _maybe_start_trials(self) -> None:
+        running = sum(1 for t in self.trials
+                      if t.status == TrialStatus.RUNNING)
+        while running < self.max_concurrent:
+            trial_id = f"trial_{len(self.trials):05d}_{uuid.uuid4().hex[:6]}"
+            config = self.searcher.suggest(trial_id)
+            if config is None:
+                return
+            trial = Trial(trial_id, config)
+            self.trials.append(trial)
+            self._launch(trial)
+            running += 1
+
+    def _poll_trial(self, trial: Trial) -> None:
+        try:
+            reports, done, error = get(trial.actor.drain.remote(), timeout=30)
+        except Exception as e:  # actor died
+            self._handle_failure(trial, str(e))
+            return
+        decision = TrialDecision.CONTINUE
+        for metrics, ckpt in reports:
+            trial.iteration += 1
+            metrics.setdefault("training_iteration", trial.iteration)
+            trial.results.append(metrics)
+            trial.last_result = metrics
+            if ckpt is not None:
+                trial.checkpoint = ckpt
+            if self._should_stop_by_criteria(metrics):
+                decision = TrialDecision.STOP
+            if decision == TrialDecision.CONTINUE:
+                decision = self.scheduler.on_result(trial, metrics)
+        if decision == TrialDecision.STOP:
+            self._stop_trial(trial, TrialStatus.STOPPED)
+            self.scheduler.on_trial_complete(trial, trial.last_result)
+            self.searcher.on_trial_complete(trial.trial_id, trial.last_result)
+            return
+        if decision == TrialDecision.EXPLOIT:
+            self._exploit(trial)
+            return
+        if done:
+            if error:
+                self._handle_failure(trial, error)
+            else:
+                self._stop_trial(trial, TrialStatus.TERMINATED)
+                self.scheduler.on_trial_complete(trial, trial.last_result)
+                self.searcher.on_trial_complete(trial.trial_id,
+                                                trial.last_result)
+
+    def _should_stop_by_criteria(self, metrics: Dict) -> bool:
+        for key, threshold in self.stop_criteria.items():
+            v = metrics.get(key)
+            if v is not None and v >= threshold:
+                return True
+        return False
+
+    def _exploit(self, trial: Trial) -> None:
+        """PBT: restart from a better trial's checkpoint with mutated config.
+
+        Reference: pbt.py _exploit (:607).
+        """
+        source = self.scheduler.choose_exploit_source(trial, self.trials)
+        if source is None or source.checkpoint is None:
+            return
+        self._stop_trial(trial, TrialStatus.PENDING)
+        trial.config = self.scheduler.mutate_config(dict(source.config))
+        trial.checkpoint = source.checkpoint
+        self._launch(trial, checkpoint=source.checkpoint)
+
+    def _handle_failure(self, trial: Trial, error: str) -> None:
+        trial.failures += 1
+        self._stop_trial(trial, TrialStatus.ERROR)
+        if trial.failures <= self.max_failures:
+            # Trial-level FT: restart from its last checkpoint
+            # (reference: trial_runner.py restore-on-failure path).
+            self._launch(trial, checkpoint=trial.checkpoint)
+            trial.status = TrialStatus.RUNNING
+        else:
+            trial.error = error
+            self.searcher.on_trial_complete(trial.trial_id, None, error=True)
+
+
+@dataclass
+class TuneConfig:
+    """Reference: tune/tune_config.py."""
+
+    metric: Optional[str] = None
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+
+
+class Tuner:
+    """Reference: ``tune/tuner.py`` — Tuner(trainable, param_space).fit()."""
+
+    def __init__(self, trainable: Callable,
+                 *, param_space: Optional[Dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resources_per_trial: Optional[Dict[str, float]] = None):
+        if hasattr(trainable, "as_trainable"):
+            trainable = trainable.as_trainable()
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self.resources_per_trial = resources_per_trial
+
+    def fit(self) -> ResultGrid:
+        from ..core import runtime as runtime_mod
+
+        runtime_mod.auto_init()
+        searcher = self.tune_config.search_alg or BasicVariantGenerator(
+            self.param_space, num_samples=self.tune_config.num_samples
+        )
+        runner = TrialRunner(
+            self.trainable, searcher,
+            scheduler=self.tune_config.scheduler,
+            max_concurrent=self.tune_config.max_concurrent_trials,
+            max_failures=self.run_config.failure_config.max_failures,
+            stop=self.run_config.stop,
+            resources_per_trial=self.resources_per_trial,
+        )
+        return runner.run()
+
+
+def run(trainable: Callable, config: Optional[Dict] = None,
+        num_samples: int = 1, scheduler: Optional[TrialScheduler] = None,
+        stop: Optional[Dict] = None, max_concurrent_trials: int = 4,
+        **kwargs) -> ResultGrid:
+    """Functional entry point (reference: ``tune.run``, tune/tune.py:129)."""
+    tuner = Tuner(
+        trainable,
+        param_space=config,
+        tune_config=TuneConfig(num_samples=num_samples, scheduler=scheduler,
+                               max_concurrent_trials=max_concurrent_trials),
+        run_config=RunConfig(stop=stop),
+    )
+    return tuner.fit()
+
+
+def report(metrics: Dict, checkpoint: Optional[Checkpoint] = None) -> None:
+    """In-trial reporting (reference: ``tune.report`` / session.report)."""
+    from ..train.session import report as _report
+
+    _report(metrics, checkpoint)
