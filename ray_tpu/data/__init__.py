@@ -1,0 +1,37 @@
+"""Data library: distributed datasets over object-store blocks.
+
+Reference analog: ``python/ray/data``.
+"""
+
+from .block import Block, BlockAccessor
+from .dataset import (
+    Dataset,
+    GroupedData,
+    from_items,
+    from_numpy,
+    from_pandas,
+)
+from .dataset import range_ as range  # noqa: A001 - mirrors ray.data.range
+from .datasource import (
+    BinaryDatasource,
+    CSVDatasource,
+    Datasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_numpy,
+    read_parquet,
+)
+from .pipeline import DatasetPipeline
+
+__all__ = [
+    "BinaryDatasource", "Block", "BlockAccessor", "CSVDatasource", "Dataset",
+    "DatasetPipeline", "Datasource", "GroupedData", "JSONDatasource",
+    "NumpyDatasource", "ParquetDatasource", "from_items", "from_numpy",
+    "from_pandas", "range", "read_binary_files", "read_csv",
+    "read_datasource", "read_json", "read_numpy", "read_parquet",
+]

@@ -1,0 +1,146 @@
+"""Blocks: the unit of distributed data.
+
+Reference analog: ``python/ray/data/block.py:234`` (BlockAccessor) with
+format-specific impls (``_internal/{arrow,pandas,simple}_block.py``). A
+block is one of: a list of rows (simple), a dict of numpy arrays (columnar —
+the TPU-relevant format: feeds device meshes without conversion), or a
+pandas DataFrame. BlockAccessor normalizes across them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Union
+
+import numpy as np
+
+Block = Union[List[Any], Dict[str, np.ndarray], "pandas.DataFrame"]
+
+
+class BlockAccessor:
+    def __init__(self, block: Block):
+        self._block = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    # -- introspection -------------------------------------------------------
+    def num_rows(self) -> int:
+        b = self._block
+        if isinstance(b, list):
+            return len(b)
+        if isinstance(b, dict):
+            return len(next(iter(b.values()))) if b else 0
+        return len(b)  # pandas
+
+    def size_bytes(self) -> int:
+        b = self._block
+        if isinstance(b, dict):
+            return int(sum(v.nbytes for v in b.values()))
+        if isinstance(b, list):
+            import sys
+
+            return sum(sys.getsizeof(r) for r in b[:100]) * max(
+                1, len(b) // max(1, min(len(b), 100))
+            )
+        return int(b.memory_usage(deep=True).sum())
+
+    # -- conversion ----------------------------------------------------------
+    def to_rows(self) -> List[Any]:
+        b = self._block
+        if isinstance(b, list):
+            return b
+        if isinstance(b, dict):
+            keys = list(b.keys())
+            n = self.num_rows()
+            return [{k: b[k][i] for k in keys} for i in range(n)]
+        return b.to_dict("records")
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        b = self._block
+        if isinstance(b, dict):
+            return b
+        if isinstance(b, list):
+            if not b:
+                return {}
+            if isinstance(b[0], dict):
+                keys = b[0].keys()
+                return {k: np.asarray([r[k] for r in b]) for k in keys}
+            return {"value": np.asarray(b)}
+        return {c: b[c].to_numpy() for c in b.columns}
+
+    def to_pandas(self):
+        import pandas as pd
+
+        b = self._block
+        if isinstance(b, list):
+            if b and not isinstance(b[0], dict):
+                return pd.DataFrame({"value": b})
+            return pd.DataFrame(b)
+        if isinstance(b, dict):
+            return pd.DataFrame(b)
+        return b
+
+    def to_format(self, batch_format: str):
+        if batch_format in ("numpy", "np"):
+            return self.to_numpy()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("default", "rows", "native"):
+            return self.to_rows()
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    # -- ops -----------------------------------------------------------------
+    def slice(self, start: int, end: int) -> Block:
+        b = self._block
+        if isinstance(b, dict):
+            return {k: v[start:end] for k, v in b.items()}
+        return b[start:end] if isinstance(b, list) else b.iloc[start:end]
+
+    def take(self, n: int) -> List[Any]:
+        return BlockAccessor(self.slice(0, n)).to_rows()
+
+    def sample_keys(self, key) -> List[Any]:
+        rows = self.to_rows()
+        return [_key_of(r, key) for r in rows]
+
+
+def _key_of(row, key):
+    if key is None:
+        return row
+    if callable(key):
+        return key(row)
+    if isinstance(row, dict):
+        return row[key]
+    return getattr(row, key)
+
+
+def build_blocks(items: List[Any], num_blocks: int) -> List[Block]:
+    """Even split of a row list into blocks."""
+    n = len(items)
+    num_blocks = max(1, min(num_blocks, n or 1))
+    out = []
+    base, extra = divmod(n, num_blocks)
+    idx = 0
+    for i in range(num_blocks):
+        size = base + (1 if i < extra else 0)
+        out.append(items[idx: idx + size])
+        idx += size
+    return out
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    if not blocks:
+        return []
+    first = blocks[0]
+    if isinstance(first, dict):
+        keys = first.keys()
+        return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+    if isinstance(first, list):
+        out: List[Any] = []
+        for b in blocks:
+            out.extend(b)
+        return out
+    import pandas as pd
+
+    return pd.concat(blocks, ignore_index=True)

@@ -1,0 +1,212 @@
+"""Datasources: pluggable read/write for files.
+
+Reference analog: ``python/ray/data/datasource/datasource.py`` (Datasource
+read/write API) + the per-format datasources (parquet, csv, json, numpy,
+binary). Reads produce one read task per file/fragment so IO parallelizes
+over the task layer; parquet gates on pyarrow availability.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import glob as _glob
+import json as _json
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core import get, put, remote
+from .block import BlockAccessor
+from .dataset import Dataset, from_items
+
+
+class Datasource:
+    """Subclass and implement read_task_args/read_file + write_block."""
+
+    def expand_paths(self, paths) -> List[str]:
+        if isinstance(paths, str):
+            paths = [paths]
+        out: List[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                out.extend(sorted(
+                    os.path.join(p, f) for f in os.listdir(p)
+                    if not f.startswith(".")
+                ))
+            elif any(c in p for c in "*?["):
+                out.extend(sorted(_glob.glob(p)))
+            else:
+                out.append(p)
+        if not out:
+            raise FileNotFoundError(f"no files matched {paths}")
+        return out
+
+    def read_file(self, path: str):
+        raise NotImplementedError
+
+    def write_block(self, block, path: str) -> None:
+        raise NotImplementedError
+
+    def read(self, paths, parallelism: int = 8) -> Dataset:
+        files = self.expand_paths(paths)
+        reader = remote(self.__class__._read_task)
+        refs = [reader.remote(self.__class__, f) for f in files]
+        return Dataset(refs)
+
+    @staticmethod
+    def _read_task(cls, path):
+        return cls().read_file(path)
+
+    def write(self, ds: Dataset, path: str, prefix: str = "part") -> List[str]:
+        os.makedirs(path, exist_ok=True)
+        ext = getattr(self, "EXT", "dat")
+        writer = remote(self.__class__._write_task)
+        paths = [
+            os.path.join(path, f"{prefix}-{i:05d}.{ext}")
+            for i in range(ds.num_blocks())
+        ]
+        get([
+            writer.remote(self.__class__, ref, p)
+            for ref, p in zip(ds._blocks, paths)
+        ])
+        return paths
+
+    @staticmethod
+    def _write_task(cls, block, path):
+        cls().write_block(block, path)
+        return path
+
+
+class CSVDatasource(Datasource):
+    EXT = "csv"
+
+    def read_file(self, path: str):
+        with open(path, newline="") as f:
+            rows = list(_csv.DictReader(f))
+        for row in rows:
+            for k, v in row.items():
+                try:
+                    row[k] = int(v)
+                except (TypeError, ValueError):
+                    try:
+                        row[k] = float(v)
+                    except (TypeError, ValueError):
+                        pass
+        return rows
+
+    def write_block(self, block, path: str) -> None:
+        rows = BlockAccessor.for_block(block).to_rows()
+        if not rows:
+            open(path, "w").close()
+            return
+        keys = list(rows[0].keys()) if isinstance(rows[0], dict) else ["value"]
+        with open(path, "w", newline="") as f:
+            w = _csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            for r in rows:
+                w.writerow(r if isinstance(r, dict) else {"value": r})
+
+
+class JSONDatasource(Datasource):
+    EXT = "json"
+
+    def read_file(self, path: str):
+        rows = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(_json.loads(line))
+        return rows
+
+    def write_block(self, block, path: str) -> None:
+        rows = BlockAccessor.for_block(block).to_rows()
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(_json.dumps(_jsonable(r)) + "\n")
+
+
+class NumpyDatasource(Datasource):
+    EXT = "npy"
+
+    def read_file(self, path: str):
+        arr = np.load(path, allow_pickle=False)
+        return {"data": arr}
+
+    def write_block(self, block, path: str) -> None:
+        cols = BlockAccessor.for_block(block).to_numpy()
+        if len(cols) == 1:
+            np.save(path, next(iter(cols.values())))
+        else:
+            np.savez(path, **cols)
+
+
+class ParquetDatasource(Datasource):
+    EXT = "parquet"
+
+    def read_file(self, path: str):
+        try:
+            import pyarrow.parquet as pq
+        except ImportError as e:
+            raise ImportError(
+                "parquet support requires pyarrow (not installed)"
+            ) from e
+        return pq.read_table(path).to_pandas()
+
+    def write_block(self, block, path: str) -> None:
+        try:
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+        except ImportError as e:
+            raise ImportError(
+                "parquet support requires pyarrow (not installed)"
+            ) from e
+        df = BlockAccessor.for_block(block).to_pandas()
+        pq.write_table(pa.Table.from_pandas(df), path)
+
+
+class BinaryDatasource(Datasource):
+    EXT = "bin"
+
+    def read_file(self, path: str):
+        with open(path, "rb") as f:
+            return [{"bytes": f.read(), "path": path}]
+
+
+def _jsonable(row):
+    if isinstance(row, dict):
+        return {k: _jsonable(v) for k, v in row.items()}
+    if isinstance(row, (np.integer,)):
+        return int(row)
+    if isinstance(row, (np.floating,)):
+        return float(row)
+    if isinstance(row, np.ndarray):
+        return row.tolist()
+    return row
+
+
+# -- read/write API (reference: data/read_api.py surface) --------------------
+
+def read_csv(paths, parallelism: int = 8) -> Dataset:
+    return CSVDatasource().read(paths, parallelism)
+
+
+def read_json(paths, parallelism: int = 8) -> Dataset:
+    return JSONDatasource().read(paths, parallelism)
+
+
+def read_numpy(paths, parallelism: int = 8) -> Dataset:
+    return NumpyDatasource().read(paths, parallelism)
+
+
+def read_parquet(paths, parallelism: int = 8) -> Dataset:
+    return ParquetDatasource().read(paths, parallelism)
+
+
+def read_binary_files(paths, parallelism: int = 8) -> Dataset:
+    return BinaryDatasource().read(paths, parallelism)
+
+
+def read_datasource(source: Datasource, paths, parallelism: int = 8) -> Dataset:
+    return source.read(paths, parallelism)

@@ -1,0 +1,109 @@
+"""DatasetPipeline: windowed streaming execution over a dataset.
+
+Reference analog: ``python/ray/data/dataset_pipeline.py:60`` + its executor
+(``_internal/pipeline_executor.py:25``) — a pipeline is a sequence of
+windows (block subsets); per-window transforms run while downstream windows
+are consumed, overlapping preprocessing with training — the host-side input
+pipeline for device meshes.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterator, List, Optional
+
+from .dataset import Dataset
+
+
+class DatasetPipeline:
+    def __init__(self, window_factories: List[Callable[[], Dataset]],
+                 length: Optional[int] = None):
+        self._factories = window_factories
+        self._transforms: List[Callable[[Dataset], Dataset]] = []
+        self._length = length if length is not None else len(window_factories)
+
+    @classmethod
+    def from_dataset(cls, ds: Dataset, blocks_per_window: int = 2
+                     ) -> "DatasetPipeline":
+        blocks = ds._blocks
+        windows = [
+            blocks[i: i + blocks_per_window]
+            for i in range(0, len(blocks), blocks_per_window)
+        ]
+        return cls([(lambda w=w: Dataset(list(w))) for w in windows])
+
+    def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
+        base = list(self._factories)
+        if times is None:
+            def infinite():
+                while True:
+                    yield from base
+
+            pipe = DatasetPipeline(base, length=None)
+            pipe._factory_iter = infinite  # type: ignore[attr-defined]
+            pipe._infinite = True
+            pipe._transforms = list(self._transforms)
+            return pipe
+        pipe = DatasetPipeline(base * times)
+        pipe._transforms = list(self._transforms)
+        return pipe
+
+    # -- per-window transforms ----------------------------------------------
+    def _chain(self, t: Callable[[Dataset], Dataset]) -> "DatasetPipeline":
+        pipe = DatasetPipeline(self._factories, self._length)
+        pipe._transforms = self._transforms + [t]
+        if getattr(self, "_infinite", False):
+            pipe._infinite = True
+            pipe._factory_iter = self._factory_iter  # type: ignore
+        return pipe
+
+    def map(self, fn) -> "DatasetPipeline":
+        return self._chain(lambda ds: ds.map(fn))
+
+    def map_batches(self, fn, **kwargs) -> "DatasetPipeline":
+        return self._chain(lambda ds: ds.map_batches(fn, **kwargs))
+
+    def filter(self, fn) -> "DatasetPipeline":
+        return self._chain(lambda ds: ds.filter(fn))
+
+    def random_shuffle_each_window(self, seed=None) -> "DatasetPipeline":
+        return self._chain(lambda ds: ds.random_shuffle(seed))
+
+    # -- consumption ---------------------------------------------------------
+    def iter_datasets(self) -> Iterator[Dataset]:
+        factories = (self._factory_iter()  # type: ignore[attr-defined]
+                     if getattr(self, "_infinite", False)
+                     else iter(self._factories))
+        for factory in factories:
+            ds = factory()
+            for t in self._transforms:
+                ds = t(ds)
+            yield ds
+
+    def iter_rows(self) -> Iterator[Any]:
+        for ds in self.iter_datasets():
+            yield from ds.iter_rows()
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        for ds in self.iter_datasets():
+            yield from ds.iter_batches(**kwargs)
+
+    def take(self, n: int) -> List[Any]:
+        out: List[Any] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def split(self, n: int) -> List["DatasetPipeline"]:
+        """Round-robin windows across n consumers (per-rank pipelines)."""
+        outs: List[List] = [[] for _ in range(n)]
+        for i, f in enumerate(self._factories):
+            outs[i % n].append(f)
+        pipes = []
+        for fs in outs:
+            p = DatasetPipeline(fs)
+            p._transforms = list(self._transforms)
+            pipes.append(p)
+        return pipes

@@ -1,0 +1,6 @@
+"""Utility components (reference: ``python/ray/util``)."""
+
+from .actor_pool import ActorPool
+from .queue import Empty, Full, Queue
+
+__all__ = ["ActorPool", "Empty", "Full", "Queue"]

@@ -1,0 +1,87 @@
+"""ActorPool: map work over a fixed set of actors.
+
+Reference analog: ``python/ray/util/actor_pool.py:8,46,120`` — submit,
+map/map_unordered, get_next with a free-actor queue.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List
+
+from ..core import get, wait
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._pending = []  # submission order
+        self._all = list(actors)
+
+    def submit(self, fn: Callable, value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; blocks if no actor is free."""
+        while not self._idle:
+            self._wait_one()
+        actor = self._idle.pop(0)
+        ref = fn(actor, value)
+        self._future_to_actor[ref] = actor
+        self._pending.append(ref)
+
+    def has_next(self) -> bool:
+        return bool(self._pending)
+
+    def get_next(self, timeout=None) -> Any:
+        """Next result in submission order."""
+        if not self._pending:
+            raise StopIteration("no pending results")
+        ref = self._pending.pop(0)
+        value = get(ref, timeout=timeout)
+        self._release(ref)
+        return value
+
+    def get_next_unordered(self, timeout=None) -> Any:
+        if not self._pending:
+            raise StopIteration("no pending results")
+        ready, _ = wait(self._pending, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        self._pending.remove(ref)
+        value = get(ref)
+        self._release(ref)
+        return value
+
+    def _wait_one(self) -> None:
+        ready, _ = wait(self._pending, num_returns=1)
+        # Result stays pending for get_next; but actor becomes free.
+        actor = self._future_to_actor.get(ready[0])
+        if actor is not None and actor not in self._idle:
+            self._idle.append(actor)
+            self._future_to_actor.pop(ready[0], None)
+
+    def _release(self, ref) -> None:
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is not None and actor not in self._idle:
+            self._idle.append(actor)
+
+    def map(self, fn: Callable, values: Iterable[Any]) -> Iterator[Any]:
+        values = list(values)
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def pop_idle(self):
+        return self._idle.pop(0) if self._idle else None
+
+    def push(self, actor) -> None:
+        self._idle.append(actor)

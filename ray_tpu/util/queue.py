@@ -1,0 +1,120 @@
+"""Distributed queue backed by an actor.
+
+Reference analog: ``python/ray/util/queue.py:20`` — Queue with
+put/get/put_nowait/get_nowait/qsize/empty/full semantics served by a
+dedicated actor.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+from ..core import get, remote
+from ..core.exceptions import GetTimeoutError
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int = 0):
+        from collections import deque
+
+        self.maxsize = maxsize
+        self.items = deque()
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def put(self, item) -> bool:
+        if self.maxsize > 0 and len(self.items) >= self.maxsize:
+            return False
+        self.items.append(item)
+        return True
+
+    def put_batch(self, items) -> int:
+        pushed = 0
+        for item in items:
+            if not self.put(item):
+                break
+            pushed += 1
+        return pushed
+
+    def get(self):
+        if not self.items:
+            return (False, None)
+        return (True, self.items.popleft())
+
+    def get_batch(self, n: int):
+        out = []
+        while self.items and len(out) < n:
+            out.append(self.items.popleft())
+        return out
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        cls = remote(_QueueActor)
+        self.actor = cls.options(**(actor_options or {})).remote(maxsize)
+        self.maxsize = maxsize
+
+    def qsize(self) -> int:
+        return get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if get(self.actor.put.remote(item)):
+                return
+            if not block:
+                raise Full()
+            if deadline is not None and time.monotonic() > deadline:
+                raise Full()
+            time.sleep(0.01)
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = get(self.actor.get.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty()
+            if deadline is not None and time.monotonic() > deadline:
+                raise Empty()
+            time.sleep(0.01)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        pushed = get(self.actor.put_batch.remote(list(items)))
+        if pushed < len(items):
+            raise Full()
+
+    def get_nowait_batch(self, n: int) -> List[Any]:
+        items = get(self.actor.get_batch.remote(n))
+        if len(items) < n:
+            raise Empty()
+        return items
+
+    def shutdown(self) -> None:
+        from ..core import kill
+
+        kill(self.actor)

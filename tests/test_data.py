@@ -1,0 +1,136 @@
+"""Data library tests (mirrors ``python/ray/data/tests`` coverage)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu import data as rd
+
+
+def test_from_items_count(rt_shared):
+    ds = rd.from_items(list(range(100)), parallelism=8)
+    assert ds.num_blocks() == 8
+    assert ds.count() == 100
+    assert ds.take(5) == [0, 1, 2, 3, 4]
+
+
+def test_map_and_filter(rt_shared):
+    ds = rd.range(20, parallelism=4)
+    out = ds.map(lambda x: x * 2).filter(lambda x: x % 4 == 0)
+    assert sorted(out.take_all()) == [0, 4, 8, 12, 16, 20, 24, 28, 32, 36]
+
+
+def test_flat_map(rt_shared):
+    ds = rd.from_items([1, 2, 3], parallelism=2)
+    assert sorted(ds.flat_map(lambda x: [x, x]).take_all()) == [1, 1, 2, 2, 3, 3]
+
+
+def test_map_batches_numpy(rt_shared):
+    ds = rd.from_numpy(np.arange(32, dtype=np.float32), parallelism=4)
+    out = ds.map_batches(
+        lambda b: {"data": b["data"] * 10}, batch_format="numpy"
+    )
+    total = out.to_numpy()
+    np.testing.assert_allclose(
+        np.sort(total["data"]), np.arange(32, dtype=np.float32) * 10
+    )
+
+
+def test_aggregates(rt_shared):
+    ds = rd.from_items([{"a": i} for i in range(10)], parallelism=3)
+    assert ds.sum("a") == 45
+    assert ds.mean("a") == 4.5
+    assert ds.min("a") == 0
+    assert ds.max("a") == 9
+
+
+def test_random_shuffle(rt_shared):
+    ds = rd.range(50, parallelism=4)
+    shuffled = ds.random_shuffle(seed=0)
+    rows = shuffled.take_all()
+    assert sorted(rows) == list(range(50))
+    assert rows != list(range(50))
+
+
+def test_sort_and_groupby(rt_shared):
+    ds = rd.from_items(
+        [{"k": i % 3, "v": i} for i in range(9)], parallelism=3
+    )
+    s = ds.sort(key="v", descending=True).take(3)
+    assert [r["v"] for r in s] == [8, 7, 6]
+    counts = {r["key"]: r["count"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 3, 1: 3, 2: 3}
+
+
+def test_split_for_ranks(rt_shared):
+    ds = rd.range(40, parallelism=4)
+    shards = ds.split(2)
+    assert len(shards) == 2
+    all_rows = sorted(shards[0].take_all() + shards[1].take_all())
+    assert all_rows == list(range(40))
+
+
+def test_repartition(rt_shared):
+    ds = rd.range(30, parallelism=2).repartition(6)
+    assert ds.num_blocks() == 6
+    assert sorted(ds.take_all()) == list(range(30))
+
+
+def test_iter_batches(rt_shared):
+    ds = rd.from_numpy(np.arange(100), parallelism=5)
+    batches = list(ds.iter_batches(batch_size=32, batch_format="numpy"))
+    sizes = [len(b["data"]) for b in batches]
+    assert sum(sizes) == 100
+    assert all(s == 32 for s in sizes[:-1])
+
+
+def test_to_jax(rt_shared):
+    import jax
+
+    ds = rd.from_numpy(np.arange(64, dtype=np.float32), parallelism=4)
+    batches = list(ds.to_jax(batch_size=16))
+    assert len(batches) == 4
+    assert isinstance(batches[0]["data"], jax.Array)
+
+
+def test_csv_roundtrip(rt_shared, tmp_path):
+    ds = rd.from_items(
+        [{"x": i, "y": i * 1.5} for i in range(20)], parallelism=2
+    )
+    paths = rd.CSVDatasource().write(ds, str(tmp_path / "csvs"))
+    assert len(paths) == 2
+    back = rd.read_csv(str(tmp_path / "csvs"))
+    rows = sorted(back.take_all(), key=lambda r: r["x"])
+    assert rows[3] == {"x": 3, "y": 4.5}
+
+
+def test_json_roundtrip(rt_shared, tmp_path):
+    ds = rd.from_items([{"a": i} for i in range(10)], parallelism=2)
+    rd.JSONDatasource().write(ds, str(tmp_path / "js"))
+    back = rd.read_json(str(tmp_path / "js"))
+    assert sorted(r["a"] for r in back.take_all()) == list(range(10))
+
+
+def test_pipeline_windows(rt_shared):
+    ds = rd.from_numpy(np.arange(40), parallelism=8)
+    pipe = ds.window(blocks_per_window=2).map_batches(
+        lambda b: {"data": b["data"] + 1}, batch_format="numpy"
+    )
+    rows = [int(r["data"]) for r in pipe.iter_rows()]
+    assert sorted(rows) == list(range(1, 41))
+
+
+def test_pipeline_repeat_epochs(rt_shared):
+    ds = rd.range(10, parallelism=2)
+    pipe = ds.repeat(3)
+    assert len(pipe.take(30)) == 30
+
+
+def test_actor_pool_compute(rt_shared):
+    ds = rd.from_numpy(np.arange(16), parallelism=4)
+    out = ds.map_batches(
+        lambda b: {"data": np.asarray(b["data"]) * 2},
+        batch_format="numpy", compute="actors",
+    )
+    assert sorted(int(x) for x in out.to_numpy()["data"]) == [
+        i * 2 for i in range(16)
+    ]
