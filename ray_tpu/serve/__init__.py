@@ -1,0 +1,24 @@
+"""Serve library: model serving on actors.
+
+Reference analog: ``python/ray/serve``.
+"""
+
+from ._internal import AutoscalingConfig, DeploymentInfo, ServeController
+from .api import (
+    Application,
+    Deployment,
+    DeploymentHandle,
+    batch,
+    deployment,
+    get_deployment_handle,
+    list_deployments,
+    run,
+    shutdown,
+    start,
+)
+
+__all__ = [
+    "Application", "AutoscalingConfig", "Deployment", "DeploymentHandle",
+    "DeploymentInfo", "ServeController", "batch", "deployment",
+    "get_deployment_handle", "list_deployments", "run", "shutdown", "start",
+]

@@ -1,0 +1,302 @@
+"""Serve internals: controller, replica, router, autoscaling.
+
+Reference analog (call stack SURVEY §3.5):
+  - ``serve/controller.py:61,229,330`` — ServeController actor with a
+    reconcile loop driving DeploymentState replica scaling
+  - ``serve/_private/deployment_state.py:942,1248`` — target-vs-actual
+    replica reconciliation
+  - ``serve/_private/router.py:62,221`` — replica set + assignment honoring
+    ``max_concurrent_queries``
+  - ``serve/_private/autoscaling_policy.py:93,127`` — queue-metric-based
+    replica target (the policy math carries over unchanged)
+  - ``serve/_private/replica.py`` — replica actor wrapping the user
+    callable.
+
+TPU note: replicas hosting pjit-compiled models are plain actors here —
+model placement/sharding happens inside the replica via ``parallel``.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core import get, kill, remote, wait
+from ..core.actor import ActorHandle
+
+
+@dataclass
+class AutoscalingConfig:
+    """Reference: serve/config.py AutoscalingConfig."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_num_ongoing_requests_per_replica: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+
+@dataclass
+class DeploymentInfo:
+    name: str
+    deployment_def: Any  # class or function (cloudpickleable)
+    init_args: tuple = ()
+    init_kwargs: dict = field(default_factory=dict)
+    num_replicas: int = 1
+    max_concurrent_queries: int = 100
+    route_prefix: Optional[str] = None
+    autoscaling: Optional[AutoscalingConfig] = None
+    ray_actor_options: dict = field(default_factory=dict)
+    version: int = 0
+
+
+class _Replica:
+    """Replica actor body (reference: RayServeReplica)."""
+
+    def __init__(self, deployment_def, init_args, init_kwargs):
+        import inspect
+
+        if inspect.isclass(deployment_def):
+            self.callable = deployment_def(*init_args, **init_kwargs)
+        else:
+            self.callable = deployment_def
+        self._ongoing = 0
+        self._total = 0
+
+    def handle_request(self, args, kwargs):
+        self._ongoing += 1
+        self._total += 1
+        try:
+            fn = self.callable
+            if not callable(fn):
+                raise TypeError("deployment is not callable")
+            if hasattr(fn, "__call__") and not isinstance(fn, type):
+                result = fn(*args, **kwargs)
+            else:
+                result = fn(*args, **kwargs)
+            import inspect
+
+            if inspect.iscoroutine(result):
+                import asyncio
+
+                result = asyncio.new_event_loop().run_until_complete(result)
+            return result
+        finally:
+            self._ongoing -= 1
+
+    def call_method(self, method, args, kwargs):
+        self._ongoing += 1
+        try:
+            return getattr(self.callable, method)(*args, **kwargs)
+        finally:
+            self._ongoing -= 1
+
+    def metrics(self):
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    def reconfigure(self, user_config):
+        if hasattr(self.callable, "reconfigure"):
+            self.callable.reconfigure(user_config)
+        return True
+
+
+class ServeController:
+    """Controller actor: owns deployment state, reconciles replicas.
+
+    Reference: serve/controller.py — ``deploy`` (:330) +
+    ``run_control_loop`` (:229). The loop runs inside actor method calls
+    (each ``reconcile`` tick) driven by the proxy/handles polling — or
+    explicitly by ``serve.run``.
+    """
+
+    def __init__(self):
+        self.deployments: Dict[str, DeploymentInfo] = {}
+        self.replicas: Dict[str, List[Any]] = {}
+        self._metrics: Dict[str, List[float]] = {}
+        self._last_scale_up: Dict[str, float] = {}
+        self._last_scale_down: Dict[str, float] = {}
+
+    # -- deploy API ----------------------------------------------------------
+    def deploy(self, info: DeploymentInfo) -> bool:
+        existing = self.deployments.get(info.name)
+        if existing is not None:
+            info.version = existing.version + 1
+        self.deployments[info.name] = info
+        self._reconcile_deployment(info.name, redeploy=existing is not None)
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        info = self.deployments.pop(name, None)
+        for r in self.replicas.pop(name, []):
+            try:
+                kill(r)
+            except Exception:
+                pass
+        return info is not None
+
+    def list_deployments(self) -> Dict[str, dict]:
+        return {
+            name: {
+                "num_replicas": len(self.replicas.get(name, [])),
+                "target": self._target_replicas(name),
+                "route_prefix": info.route_prefix,
+                "version": info.version,
+            }
+            for name, info in self.deployments.items()
+        }
+
+    def get_replicas(self, name: str) -> List[Any]:
+        return list(self.replicas.get(name, []))
+
+    def get_deployment_names(self) -> List[str]:
+        return list(self.deployments)
+
+    # -- reconciliation ------------------------------------------------------
+    def _target_replicas(self, name: str) -> int:
+        info = self.deployments.get(name)
+        if info is None:
+            return 0
+        if info.autoscaling is None:
+            return info.num_replicas
+        return self._autoscale_target(name, info)
+
+    def _autoscale_target(self, name: str, info: DeploymentInfo) -> int:
+        """Reference: autoscaling_policy.py:127 get_decision_num_replicas —
+        target = ceil(total_ongoing / target_per_replica), clamped, with
+        up/downscale delay."""
+        cfg = info.autoscaling
+        current = len(self.replicas.get(name, []))
+        ongoing = self._collect_ongoing(name)
+        desired = math.ceil(
+            ongoing / max(cfg.target_num_ongoing_requests_per_replica, 1e-9)
+        )
+        desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        now = time.monotonic()
+        if desired > current:
+            first = self._last_scale_up.setdefault(name, now)
+            if now - first >= cfg.upscale_delay_s:
+                self._last_scale_up.pop(name, None)
+                return desired
+            return current
+        self._last_scale_up.pop(name, None)
+        if desired < current:
+            first = self._last_scale_down.setdefault(name, now)
+            if now - first >= cfg.downscale_delay_s:
+                self._last_scale_down.pop(name, None)
+                return desired
+            return current
+        self._last_scale_down.pop(name, None)
+        return current
+
+    def _collect_ongoing(self, name: str) -> float:
+        total = 0.0
+        refs = []
+        replicas = self.replicas.get(name, [])
+        for r in replicas:
+            refs.append(r.metrics.remote())
+        if refs:
+            ready, _ = wait(refs, num_returns=len(refs), timeout=1.0)
+            for ref in ready:
+                try:
+                    total += get(ref)["ongoing"]
+                except Exception:
+                    pass
+        return total
+
+    def reconcile(self) -> Dict[str, int]:
+        """One control-loop tick (reference: run_control_loop body)."""
+        out = {}
+        for name in list(self.deployments):
+            out[name] = self._reconcile_deployment(name)
+        return out
+
+    def _reconcile_deployment(self, name: str, redeploy: bool = False) -> int:
+        info = self.deployments[name]
+        current = self.replicas.setdefault(name, [])
+        if redeploy:
+            for r in current:
+                try:
+                    kill(r)
+                except Exception:
+                    pass
+            current.clear()
+        target = self._target_replicas(name)
+        replica_cls = remote(_Replica)
+        while len(current) < target:
+            opts = dict(info.ray_actor_options)
+            actor = replica_cls.options(
+                max_concurrency=max(2, info.max_concurrent_queries),
+                **opts,
+            ).remote(info.deployment_def, info.init_args, info.init_kwargs)
+            current.append(actor)
+        while len(current) > target:
+            victim = current.pop()
+            try:
+                kill(victim)
+            except Exception:
+                pass
+        return len(current)
+
+
+class Router:
+    """Client-side replica selection (reference: router.py ReplicaSet).
+
+    Round-robin with in-flight caps per replica; refreshes its replica
+    cache from the controller (the long-poll snapshot equivalent,
+    long_poll.py:67) when stale or empty.
+    """
+
+    def __init__(self, controller, deployment_name: str,
+                 max_concurrent_queries: int = 100,
+                 refresh_interval: float = 0.5):
+        self._controller = controller
+        self._name = deployment_name
+        self._max_cq = max_concurrent_queries
+        self._replicas: List[Any] = []
+        self._rr = 0
+        self._inflight: Dict[int, int] = {}
+        self._last_refresh = 0.0
+        self._refresh_interval = refresh_interval
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if (not force and self._replicas
+                and now - self._last_refresh < self._refresh_interval):
+            return
+        self._replicas = get(
+            self._controller.get_replicas.remote(self._name)
+        )
+        self._last_refresh = now
+
+    def assign(self, method: Optional[str], args, kwargs):
+        """Pick a replica with capacity; round-robin (router.py:221)."""
+        deadline = time.monotonic() + 30
+        while True:
+            self._refresh()
+            n = len(self._replicas)
+            if n:
+                for probe in range(n):
+                    idx = (self._rr + probe) % n
+                    if self._inflight.get(idx, 0) < self._max_cq:
+                        self._rr = idx + 1
+                        replica = self._replicas[idx]
+                        self._inflight[idx] = self._inflight.get(idx, 0) + 1
+                        try:
+                            if method:
+                                return replica.call_method.remote(
+                                    method, args, kwargs
+                                )
+                            return replica.handle_request.remote(args, kwargs)
+                        finally:
+                            # In-flight decremented optimistically after
+                            # dispatch; precise tracking uses replica
+                            # metrics (collected by the controller).
+                            self._inflight[idx] -= 1
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replica available for {self._name!r}"
+                )
+            self._refresh(force=True)
+            time.sleep(0.05)

@@ -1,0 +1,365 @@
+"""Serve public API: deployments, handles, run/shutdown, HTTP proxy.
+
+Reference analog: ``python/ray/serve/api.py`` + ``serve/deployment.py``
+(@serve.deployment / .options / .bind) and ``serve/handle.py``
+(DeploymentHandle). The HTTP proxy uses a stdlib threading HTTP server in
+place of uvicorn/starlette (same per-node proxy role as
+``http_proxy.py:189``).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core import get, get_actor, kill, remote
+from ._internal import (
+    AutoscalingConfig,
+    DeploymentInfo,
+    Router,
+    ServeController,
+)
+
+_CONTROLLER_NAME = "SERVE_CONTROLLER"
+_state: Dict[str, Any] = {"controller": None, "http_server": None,
+                          "reconciler": None, "stop": None}
+
+
+def start(http_port: int = 8000, http_host: str = "127.0.0.1",
+          detached: bool = False) -> None:
+    """Start the Serve instance (controller + proxy + reconcile loop)."""
+    if _state["controller"] is not None:
+        return
+    controller_cls = remote(ServeController)
+    controller = controller_cls.options(
+        name=_CONTROLLER_NAME, max_concurrency=16
+    ).remote()
+    _state["controller"] = controller
+    stop = threading.Event()
+    _state["stop"] = stop
+
+    def reconcile_loop():
+        # Reference: run_control_loop (controller.py:229) — here driven by
+        # a driver-side thread ticking the controller actor.
+        while not stop.wait(0.25):
+            try:
+                get(controller.reconcile.remote(), timeout=30)
+            except Exception:
+                pass
+
+    t = threading.Thread(target=reconcile_loop, daemon=True,
+                         name="serve-reconciler")
+    t.start()
+    _state["reconciler"] = t
+    _start_http_proxy(http_host, http_port)
+
+
+def shutdown() -> None:
+    if _state["stop"] is not None:
+        _state["stop"].set()
+    server = _state.get("http_server")
+    if server is not None:
+        try:
+            server.shutdown()
+        except Exception:
+            pass
+        _state["http_server"] = None
+    controller = _state.get("controller")
+    if controller is not None:
+        try:
+            for name in get(controller.get_deployment_names.remote(),
+                            timeout=10):
+                get(controller.delete_deployment.remote(name), timeout=10)
+            kill(controller)
+        except Exception:
+            pass
+        _state["controller"] = None
+
+
+def _controller():
+    if _state["controller"] is None:
+        start()
+    return _state["controller"]
+
+
+class DeploymentHandle:
+    """Python-side handle (reference: serve/handle.py ServeHandle)."""
+
+    def __init__(self, name: str, max_concurrent_queries: int = 100):
+        self._name = name
+        self._router = Router(_controller(), name, max_concurrent_queries)
+
+    def remote(self, *args, **kwargs):
+        return self._router.assign(None, args, kwargs)
+
+    def method(self, method_name: str) -> "DeploymentMethodHandle":
+        return DeploymentMethodHandle(self, method_name)
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return DeploymentMethodHandle(self, item)
+
+
+class DeploymentMethodHandle:
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        return self._handle._router.assign(self._method, args, kwargs)
+
+
+@dataclass
+class Application:
+    """A bound deployment graph node (reference: .bind() -> Application)."""
+
+    deployment: "Deployment"
+    args: tuple = ()
+    kwargs: dict = field(default_factory=dict)
+
+
+class Deployment:
+    """Reference: serve/deployment.py Deployment."""
+
+    def __init__(self, func_or_class, name: str, opts: Dict[str, Any]):
+        self._def = func_or_class
+        self.name = name
+        self._opts = opts
+        functools.update_wrapper(self, func_or_class, updated=[])
+
+    def options(self, **overrides) -> "Deployment":
+        opts = dict(self._opts)
+        name = overrides.pop("name", self.name)
+        opts.update(overrides)
+        return Deployment(self._def, name, opts)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def deploy(self, *init_args, **init_kwargs) -> DeploymentHandle:
+        o = self._opts
+        autoscaling = o.get("autoscaling_config")
+        if isinstance(autoscaling, dict):
+            autoscaling = AutoscalingConfig(**autoscaling)
+        info = DeploymentInfo(
+            name=self.name,
+            deployment_def=self._def,
+            init_args=init_args,
+            init_kwargs=init_kwargs,
+            num_replicas=o.get("num_replicas", 1),
+            max_concurrent_queries=o.get("max_concurrent_queries", 100),
+            route_prefix=o.get("route_prefix", f"/{self.name}"),
+            autoscaling=autoscaling,
+            ray_actor_options=o.get("ray_actor_options", {}),
+        )
+        get(_controller().deploy.remote(info), timeout=60)
+        return DeploymentHandle(self.name, o.get("max_concurrent_queries",
+                                                 100))
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"Deployment {self.name!r} cannot be called directly; deploy it "
+            f"with serve.run(dep.bind(...)) and use the handle."
+        )
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_concurrent_queries: int = 100,
+               route_prefix: Optional[str] = None,
+               autoscaling_config=None,
+               ray_actor_options: Optional[dict] = None):
+    """``@serve.deployment`` decorator (reference: serve/api.py)."""
+
+    def wrap(target):
+        return Deployment(target, name or target.__name__, {
+            "num_replicas": num_replicas,
+            "max_concurrent_queries": max_concurrent_queries,
+            "route_prefix": route_prefix,
+            "autoscaling_config": autoscaling_config,
+            "ray_actor_options": ray_actor_options or {},
+        })
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = None) -> DeploymentHandle:
+    """Deploy a bound application (reference: serve.run)."""
+    start()
+    dep = app.deployment
+    if route_prefix is not None:
+        dep = dep.options(route_prefix=route_prefix)
+    return dep.deploy(*app.args, **app.kwargs)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def list_deployments() -> Dict[str, dict]:
+    return get(_controller().list_deployments.remote(), timeout=30)
+
+
+# -- HTTP proxy --------------------------------------------------------------
+
+def _start_http_proxy(host: str, port: int) -> None:
+    """Threaded stdlib HTTP proxy (role of http_proxy.py HTTPProxy actor)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    handles: Dict[str, DeploymentHandle] = {}
+
+    class ProxyHandler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _route(self):
+            path = self.path.split("?")[0].strip("/")
+            parts = path.split("/")
+            name = parts[0] if parts and parts[0] else None
+            if name is None:
+                self.send_response(404)
+                self.end_headers()
+                self.wfile.write(b'{"error": "no deployment in path"}')
+                return
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            body = self.rfile.read(length) if length else b""
+            payload = None
+            if body:
+                try:
+                    payload = json.loads(body)
+                except json.JSONDecodeError:
+                    payload = body.decode("utf-8", "replace")
+            try:
+                handle = handles.get(name)
+                if handle is None:
+                    names = get(
+                        _controller().get_deployment_names.remote(),
+                        timeout=10,
+                    )
+                    if name not in names:
+                        self.send_response(404)
+                        self.end_headers()
+                        self.wfile.write(
+                            json.dumps({"error": f"unknown deployment "
+                                                 f"{name}"}).encode())
+                        return
+                    handle = DeploymentHandle(name)
+                    handles[name] = handle
+                if payload is None:
+                    ref = handle.remote()
+                else:
+                    ref = handle.remote(payload)
+                result = get(ref, timeout=60)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(json.dumps(result).encode())
+            except Exception as e:  # noqa: BLE001
+                self.send_response(500)
+                self.end_headers()
+                self.wfile.write(json.dumps({"error": str(e)}).encode())
+
+        do_GET = _route
+        do_POST = _route
+
+    try:
+        server = ThreadingHTTPServer((host, port), ProxyHandler)
+    except OSError:
+        return  # port busy (another instance); python handles still work
+    _state["http_server"] = server
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="serve-http")
+    t.start()
+
+
+# -- batching ----------------------------------------------------------------
+
+def batch(_func=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """``@serve.batch``: coalesce concurrent calls into one batched call.
+
+    Reference: ``serve/batching.py`` — the wrapped method receives a list
+    of requests and must return a list of responses.
+    """
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def wrapper(self_or_first, *args):
+            state = _batch_state_for(wrapper)
+            return state.submit(fn, self_or_first, args)
+
+        wrapper._batch_params = (max_batch_size, batch_wait_timeout_s)
+        return wrapper
+
+    if _func is not None:
+        return wrap(_func)
+    return wrap
+
+
+class _BatchState:
+    """Per-process batching state (created lazily in the replica, never at
+    decoration time — locks aren't picklable)."""
+
+    def __init__(self, max_batch_size: int, wait_timeout: float):
+        self.max_batch_size = max_batch_size
+        self.wait_timeout = wait_timeout
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.pending: List = []
+        self.results: Dict[int, Any] = {}
+        self.counter = 0
+
+    def submit(self, fn, self_obj, args):
+        with self.cond:
+            my_id = self.counter
+            self.counter += 1
+            self.pending.append((my_id, self_obj, args))
+            if len(self.pending) >= self.max_batch_size:
+                self._flush_locked(fn)
+            else:
+                self.cond.wait(timeout=self.wait_timeout)
+                if my_id not in self.results and self.pending:
+                    self._flush_locked(fn)
+            value = self.results.pop(my_id)
+        if isinstance(value, Exception):
+            raise value
+        return value
+
+    def _flush_locked(self, fn):
+        items = list(self.pending)
+        self.pending.clear()
+        if not items:
+            return
+        self_obj = items[0][1]
+        inputs = [it[2][0] if it[2] else None for it in items]
+        try:
+            outs = fn(self_obj, inputs)
+            if len(outs) != len(inputs):
+                raise ValueError("batch fn returned wrong length")
+        except Exception as e:  # noqa: BLE001
+            outs = [e] * len(inputs)
+        for (rid, _, _), out in zip(items, outs):
+            self.results[rid] = out
+        self.cond.notify_all()
+
+
+_batch_states: Dict[int, _BatchState] = {}
+_batch_states_lock = threading.Lock()
+
+
+def _batch_state_for(wrapper) -> _BatchState:
+    key = id(wrapper)
+    with _batch_states_lock:
+        state = _batch_states.get(key)
+        if state is None:
+            size, timeout = wrapper._batch_params
+            state = _BatchState(size, timeout)
+            _batch_states[key] = state
+        return state
