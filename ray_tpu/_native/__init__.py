@@ -1,0 +1,167 @@
+"""ctypes bindings for the native shared-memory arena store.
+
+The C++ store (``shm_store.cc``) is the plasma-equivalent data plane; this
+module builds it on first use (g++, cached in ``build/``) and exposes
+:class:`NativeStore`. Callers fall back to the pure-Python per-object
+segment store when the toolchain is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "build", "libshmstore.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _HERE], check=True,
+                    capture_output=True, timeout=120,
+                )
+            except Exception as e:
+                raise RuntimeError(f"native store build failed: {e}") from e
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.rt_store_create.restype = ctypes.c_void_p
+        lib.rt_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.rt_store_attach.restype = ctypes.c_void_p
+        lib.rt_store_attach.argtypes = [ctypes.c_char_p]
+        lib.rt_store_put.restype = ctypes.c_int
+        lib.rt_store_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
+        lib.rt_store_create_object.restype = ctypes.POINTER(ctypes.c_ubyte)
+        lib.rt_store_create_object.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.rt_store_seal.restype = ctypes.c_int
+        lib.rt_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_get.restype = ctypes.POINTER(ctypes.c_ubyte)
+        lib.rt_store_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.rt_store_release.restype = ctypes.c_int
+        lib.rt_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_contains.restype = ctypes.c_int
+        lib.rt_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_delete.restype = ctypes.c_int
+        lib.rt_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.rt_store_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        _load_lib()
+        return True
+    except Exception:
+        return False
+
+
+class NativeStoreError(Exception):
+    pass
+
+
+class NativeStoreFull(NativeStoreError):
+    pass
+
+
+class NativeStore:
+    """One arena per node; create in the node manager, attach in workers."""
+
+    def __init__(self, handle, name: str, owner: bool):
+        self._lib = _load_lib()
+        self._handle = ctypes.c_void_p(handle)
+        self.name = name
+        self._owner = owner
+        self._closed = False
+
+    @classmethod
+    def create(cls, name: str, capacity: int) -> "NativeStore":
+        lib = _load_lib()
+        handle = lib.rt_store_create(name.encode(), capacity)
+        if not handle:
+            raise NativeStoreError(f"failed to create shm arena {name!r}")
+        return cls(handle, name, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "NativeStore":
+        lib = _load_lib()
+        handle = lib.rt_store_attach(name.encode())
+        if not handle:
+            raise NativeStoreError(f"failed to attach shm arena {name!r}")
+        return cls(handle, name, owner=False)
+
+    def put(self, key: bytes, data: bytes) -> None:
+        rc = self._lib.rt_store_put(self._handle, key, data, len(data))
+        if rc == -1:
+            return  # already sealed: idempotent put
+        if rc == -2:
+            raise NativeStoreFull("arena full")
+        if rc == -3:
+            raise NativeStoreError("object table full")
+        if rc != 0:
+            raise NativeStoreError(f"put failed rc={rc}")
+
+    def get(self, key: bytes) -> Optional[memoryview]:
+        """Zero-copy view into the arena; release() when done with it."""
+        size = ctypes.c_uint64()
+        ptr = self._lib.rt_store_get(self._handle, key, ctypes.byref(size))
+        if not ptr:
+            return None
+        return memoryview(
+            ctypes.cast(
+                ptr, ctypes.POINTER(ctypes.c_ubyte * size.value)
+            ).contents
+        )
+
+    def release(self, key: bytes) -> None:
+        self._lib.rt_store_release(self._handle, key)
+
+    def contains(self, key: bytes) -> bool:
+        return bool(self._lib.rt_store_contains(self._handle, key))
+
+    def delete(self, key: bytes) -> bool:
+        return self._lib.rt_store_delete(self._handle, key) == 0
+
+    def stats(self) -> dict:
+        cap = ctypes.c_uint64()
+        used = ctypes.c_uint64()
+        n = ctypes.c_uint64()
+        self._lib.rt_store_stats(self._handle, ctypes.byref(cap),
+                                 ctypes.byref(used), ctypes.byref(n))
+        return {"capacity_bytes": cap.value, "used_bytes": used.value,
+                "num_objects": n.value}
+
+    def close(self, unlink: Optional[bool] = None) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._lib.rt_store_close(
+            self._handle, int(self._owner if unlink is None else unlink)
+        )
+
+    def __del__(self):
+        try:
+            self.close(unlink=False)
+        except Exception:
+            pass

@@ -1,0 +1,420 @@
+// Shared-memory arena object store — the native data plane.
+//
+// Reference analog: src/ray/object_manager/plasma/ (PlasmaStore,
+// plasma/store.h:55; dlmalloc arena over mmap/shm, plasma/dlmalloc.cc;
+// object lifecycle table, object_lifecycle_manager.h) — re-designed as a
+// single POSIX shm arena per node that ALL worker processes map directly:
+//
+//   [ StoreHeader | object table (open addressing) | data arena ]
+//
+// The allocator (first-fit free list with coalescing) and the object table
+// live inside the mapping and are guarded by one process-shared pthread
+// mutex, so creation/sealing/lookup need no server round-trip at all —
+// strictly less IPC than the reference's unix-socket protocol. Objects are
+// immutable after seal (plasma semantics); freeing returns extents to the
+// free list.
+//
+// Exposed as a C ABI consumed via ctypes (ray_tpu/_native/__init__.py).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545f53484d4152ull;  // "RT_SHMAR"
+constexpr uint32_t kKeySize = 20;                   // ObjectID bytes
+constexpr uint32_t kTableSize = 1 << 16;            // object table slots
+constexpr uint64_t kAlign = 64;                     // allocation alignment
+
+enum SlotState : uint32_t {
+  SLOT_FREE = 0,
+  SLOT_CREATED = 1,  // allocated, being written
+  SLOT_SEALED = 2,   // immutable, readable
+  SLOT_TOMBSTONE = 3,
+};
+
+struct Slot {
+  uint8_t key[kKeySize];
+  uint32_t state;
+  uint64_t offset;  // into data arena
+  uint64_t size;
+  int64_t refcount;  // pin count from readers
+};
+
+struct FreeBlock {
+  uint64_t size;
+  uint64_t next;  // offset of next free block (0 = end)
+};
+
+struct StoreHeader {
+  uint64_t magic;
+  uint64_t capacity;       // data arena bytes
+  uint64_t data_start;     // offset of arena from mapping base
+  uint64_t free_head;      // offset of first free block (arena-relative+1; 0=none)
+  uint64_t used_bytes;
+  uint64_t num_objects;
+  pthread_mutex_t mutex;
+};
+
+struct Store {
+  void* base;
+  uint64_t map_size;
+  int fd;
+  char name[256];
+  bool owner;
+};
+
+inline StoreHeader* header(Store* s) {
+  return reinterpret_cast<StoreHeader*>(s->base);
+}
+
+inline Slot* table(Store* s) {
+  return reinterpret_cast<Slot*>(
+      static_cast<char*>(s->base) + sizeof(StoreHeader));
+}
+
+inline char* arena(Store* s) {
+  return static_cast<char*>(s->base) + header(s)->data_start;
+}
+
+inline uint64_t align_up(uint64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+
+uint64_t hash_key(const uint8_t* key) {
+  // FNV-1a over the 20-byte id.
+  uint64_t h = 1469598103934665603ull;
+  for (uint32_t i = 0; i < kKeySize; i++) {
+    h ^= key[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+Slot* find_slot(Store* s, const uint8_t* key, bool for_insert) {
+  Slot* t = table(s);
+  uint64_t idx = hash_key(key) & (kTableSize - 1);
+  Slot* first_tomb = nullptr;
+  for (uint32_t probe = 0; probe < kTableSize; probe++) {
+    Slot* slot = &t[(idx + probe) & (kTableSize - 1)];
+    if (slot->state == SLOT_FREE) {
+      if (for_insert) return first_tomb ? first_tomb : slot;
+      return nullptr;
+    }
+    if (slot->state == SLOT_TOMBSTONE) {
+      if (for_insert && !first_tomb) first_tomb = slot;
+      continue;
+    }
+    if (memcmp(slot->key, key, kKeySize) == 0) return slot;
+  }
+  return for_insert ? first_tomb : nullptr;
+}
+
+// First-fit allocation from the in-arena free list. Returns arena-relative
+// offset or UINT64_MAX. Caller holds the mutex.
+uint64_t arena_alloc(Store* s, uint64_t size) {
+  StoreHeader* h = header(s);
+  size = align_up(size);
+  uint64_t prev_off = 0;  // 0 = head pointer itself
+  uint64_t cur = h->free_head;
+  while (cur != 0) {
+    FreeBlock* blk = reinterpret_cast<FreeBlock*>(arena(s) + (cur - 1));
+    if (blk->size >= size) {
+      uint64_t remaining = blk->size - size;
+      uint64_t next = blk->next;
+      if (remaining >= sizeof(FreeBlock) + kAlign) {
+        uint64_t new_off = (cur - 1) + size + 1;
+        FreeBlock* rest = reinterpret_cast<FreeBlock*>(arena(s) + (new_off - 1));
+        rest->size = remaining;
+        rest->next = next;
+        next = new_off;
+      } else {
+        size = blk->size;  // absorb the sliver
+      }
+      if (prev_off == 0) {
+        h->free_head = next;
+      } else {
+        reinterpret_cast<FreeBlock*>(arena(s) + (prev_off - 1))->next = next;
+      }
+      h->used_bytes += size;
+      return cur - 1;
+    }
+    prev_off = cur;
+    cur = blk->next;
+  }
+  return UINT64_MAX;
+}
+
+// Return an extent to the free list, coalescing with neighbors.
+// Caller holds the mutex.
+void arena_free(Store* s, uint64_t offset, uint64_t size) {
+  StoreHeader* h = header(s);
+  size = align_up(size);
+  h->used_bytes -= size;
+  // Insert sorted by offset, then coalesce.
+  uint64_t prev_off = 0;
+  uint64_t cur = h->free_head;
+  while (cur != 0 && (cur - 1) < offset) {
+    prev_off = cur;
+    cur = reinterpret_cast<FreeBlock*>(arena(s) + (cur - 1))->next;
+  }
+  FreeBlock* blk = reinterpret_cast<FreeBlock*>(arena(s) + offset);
+  blk->size = size;
+  blk->next = cur;
+  if (prev_off == 0) {
+    h->free_head = offset + 1;
+  } else {
+    FreeBlock* prev = reinterpret_cast<FreeBlock*>(arena(s) + (prev_off - 1));
+    prev->next = offset + 1;
+    // Coalesce prev + blk.
+    if ((prev_off - 1) + prev->size == offset) {
+      prev->size += blk->size;
+      prev->next = blk->next;
+      blk = prev;
+      offset = prev_off - 1;
+    }
+  }
+  // Coalesce blk + next.
+  if (blk->next != 0 && offset + blk->size == blk->next - 1) {
+    FreeBlock* nxt = reinterpret_cast<FreeBlock*>(arena(s) + (blk->next - 1));
+    blk->size += nxt->size;
+    blk->next = nxt->next;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a new store of `capacity` data bytes. Returns handle or null.
+void* rt_store_create(const char* name, uint64_t capacity) {
+  uint64_t table_bytes = sizeof(Slot) * kTableSize;
+  uint64_t data_start = align_up(sizeof(StoreHeader) + table_bytes);
+  uint64_t total = data_start + capacity;
+
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  memset(base, 0, data_start);
+  StoreHeader* h = reinterpret_cast<StoreHeader*>(base);
+  h->capacity = capacity;
+  h->data_start = data_start;
+  h->used_bytes = 0;
+  h->num_objects = 0;
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  // One giant free block spans the arena.
+  FreeBlock* blk = reinterpret_cast<FreeBlock*>(
+      static_cast<char*>(base) + data_start);
+  blk->size = capacity;
+  blk->next = 0;
+  h->free_head = 1;  // arena offset 0, +1 encoding
+  h->magic = kMagic;
+
+  Store* s = new Store{base, total, fd, {0}, true};
+  strncpy(s->name, name, sizeof(s->name) - 1);
+  return s;
+}
+
+void* rt_store_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  StoreHeader* h = reinterpret_cast<StoreHeader*>(base);
+  if (h->magic != kMagic) {
+    munmap(base, st.st_size);
+    close(fd);
+    return nullptr;
+  }
+  Store* s = new Store{base, (uint64_t)st.st_size, fd, {0}, false};
+  strncpy(s->name, name, sizeof(s->name) - 1);
+  return s;
+}
+
+static int lock_robust(StoreHeader* h) {
+  int rc = pthread_mutex_lock(&h->mutex);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mutex);
+    rc = 0;
+  }
+  return rc;
+}
+
+// Allocate + copy + seal in one call. Returns 0 ok, -1 exists, -2 full,
+// -3 table full, -4 error.
+int rt_store_put(void* handle, const uint8_t* key, const uint8_t* data,
+                 uint64_t size) {
+  Store* s = static_cast<Store*>(handle);
+  StoreHeader* h = header(s);
+  if (lock_robust(h) != 0) return -4;
+  Slot* existing = find_slot(s, key, false);
+  if (existing && existing->state == SLOT_SEALED) {
+    pthread_mutex_unlock(&h->mutex);
+    return -1;
+  }
+  Slot* slot = find_slot(s, key, true);
+  if (!slot) {
+    pthread_mutex_unlock(&h->mutex);
+    return -3;
+  }
+  uint64_t off = arena_alloc(s, size ? size : 1);
+  if (off == UINT64_MAX) {
+    pthread_mutex_unlock(&h->mutex);
+    return -2;
+  }
+  memcpy(slot->key, key, kKeySize);
+  slot->offset = off;
+  slot->size = size;
+  slot->refcount = 0;
+  memcpy(arena(s) + off, data, size);
+  slot->state = SLOT_SEALED;
+  h->num_objects++;
+  pthread_mutex_unlock(&h->mutex);
+  return 0;
+}
+
+// Reserve space for zero-copy writes: returns pointer to write into, or
+// null. Seal with rt_store_seal when done.
+uint8_t* rt_store_create_object(void* handle, const uint8_t* key,
+                                uint64_t size) {
+  Store* s = static_cast<Store*>(handle);
+  StoreHeader* h = header(s);
+  if (lock_robust(h) != 0) return nullptr;
+  Slot* slot = find_slot(s, key, true);
+  if (!slot || slot->state == SLOT_SEALED) {
+    pthread_mutex_unlock(&h->mutex);
+    return nullptr;
+  }
+  uint64_t off = arena_alloc(s, size ? size : 1);
+  if (off == UINT64_MAX) {
+    pthread_mutex_unlock(&h->mutex);
+    return nullptr;
+  }
+  memcpy(slot->key, key, kKeySize);
+  slot->offset = off;
+  slot->size = size;
+  slot->refcount = 0;
+  slot->state = SLOT_CREATED;
+  pthread_mutex_unlock(&h->mutex);
+  return reinterpret_cast<uint8_t*>(arena(s) + off);
+}
+
+int rt_store_seal(void* handle, const uint8_t* key) {
+  Store* s = static_cast<Store*>(handle);
+  StoreHeader* h = header(s);
+  if (lock_robust(h) != 0) return -4;
+  Slot* slot = find_slot(s, key, false);
+  if (!slot || slot->state != SLOT_CREATED) {
+    pthread_mutex_unlock(&h->mutex);
+    return -1;
+  }
+  slot->state = SLOT_SEALED;
+  h->num_objects++;
+  pthread_mutex_unlock(&h->mutex);
+  return 0;
+}
+
+// Get a sealed object: returns pointer into the arena (zero-copy) and
+// writes size. Pins the object (caller must rt_store_release).
+const uint8_t* rt_store_get(void* handle, const uint8_t* key,
+                            uint64_t* size_out) {
+  Store* s = static_cast<Store*>(handle);
+  StoreHeader* h = header(s);
+  if (lock_robust(h) != 0) return nullptr;
+  Slot* slot = find_slot(s, key, false);
+  if (!slot || slot->state != SLOT_SEALED) {
+    pthread_mutex_unlock(&h->mutex);
+    return nullptr;
+  }
+  slot->refcount++;
+  *size_out = slot->size;
+  const uint8_t* ptr = reinterpret_cast<uint8_t*>(arena(s) + slot->offset);
+  pthread_mutex_unlock(&h->mutex);
+  return ptr;
+}
+
+int rt_store_release(void* handle, const uint8_t* key) {
+  Store* s = static_cast<Store*>(handle);
+  StoreHeader* h = header(s);
+  if (lock_robust(h) != 0) return -4;
+  Slot* slot = find_slot(s, key, false);
+  if (slot && slot->refcount > 0) slot->refcount--;
+  pthread_mutex_unlock(&h->mutex);
+  return 0;
+}
+
+int rt_store_contains(void* handle, const uint8_t* key) {
+  Store* s = static_cast<Store*>(handle);
+  StoreHeader* h = header(s);
+  if (lock_robust(h) != 0) return 0;
+  Slot* slot = find_slot(s, key, false);
+  int ok = (slot && slot->state == SLOT_SEALED) ? 1 : 0;
+  pthread_mutex_unlock(&h->mutex);
+  return ok;
+}
+
+// Delete (even if pinned — single-host trust model; caller coordinates).
+int rt_store_delete(void* handle, const uint8_t* key) {
+  Store* s = static_cast<Store*>(handle);
+  StoreHeader* h = header(s);
+  if (lock_robust(h) != 0) return -4;
+  Slot* slot = find_slot(s, key, false);
+  if (!slot || slot->state == SLOT_FREE) {
+    pthread_mutex_unlock(&h->mutex);
+    return -1;
+  }
+  arena_free(s, slot->offset, slot->size ? slot->size : 1);
+  slot->state = SLOT_TOMBSTONE;
+  h->num_objects--;
+  pthread_mutex_unlock(&h->mutex);
+  return 0;
+}
+
+void rt_store_stats(void* handle, uint64_t* capacity, uint64_t* used,
+                    uint64_t* num_objects) {
+  Store* s = static_cast<Store*>(handle);
+  StoreHeader* h = header(s);
+  lock_robust(h);
+  *capacity = h->capacity;
+  *used = h->used_bytes;
+  *num_objects = h->num_objects;
+  pthread_mutex_unlock(&h->mutex);
+}
+
+void rt_store_close(void* handle, int unlink_shm) {
+  Store* s = static_cast<Store*>(handle);
+  munmap(s->base, s->map_size);
+  close(s->fd);
+  if (unlink_shm) shm_unlink(s->name);
+  delete s;
+}
+
+}  // extern "C"

@@ -1,0 +1,28 @@
+"""Autoscaler: demand-driven cluster resizing with pluggable providers.
+
+Reference analog: ``python/ray/autoscaler/_private/`` —
+``StandardAutoscaler.update`` (autoscaler.py:162,353),
+``ResourceDemandScheduler.get_nodes_to_launch`` bin-packing
+(resource_demand_scheduler.py:43,102), ``LoadMetrics``, ``NodeProvider``
+plugin API (node_provider.py) with the fake multi-node provider for tests
+(fake_multi_node/node_provider.py:237).
+
+TPU-native: node types describe pod slices (``tpu_slice: v5e-8`` with chip
+counts and ICI shape labels), so demands expressed as mesh claims lower to
+slice-typed node launches.
+"""
+
+from .autoscaler import (
+    AutoscalerConfig,
+    LoadMetrics,
+    NodeType,
+    ResourceDemandScheduler,
+    StandardAutoscaler,
+)
+from .providers import FakeNodeProvider, LocalNodeProvider, NodeProvider
+
+__all__ = [
+    "AutoscalerConfig", "FakeNodeProvider", "LoadMetrics",
+    "LocalNodeProvider", "NodeProvider", "NodeType",
+    "ResourceDemandScheduler", "StandardAutoscaler",
+]

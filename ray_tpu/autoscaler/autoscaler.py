@@ -1,0 +1,189 @@
+"""Autoscaler core: load metrics, bin-packing, scale up/down decisions.
+
+Reference analog:
+  - ``autoscaler/_private/load_metrics.py`` — per-node utilization +
+    pending demand aggregation
+  - ``autoscaler/_private/resource_demand_scheduler.py:43,102`` —
+    ``get_nodes_to_launch``: first-fit bin-packing of pending demands over
+    existing + launchable node types, respecting max workers
+  - ``autoscaler/_private/autoscaler.py:162,353`` — ``StandardAutoscaler.
+    update``: terminate idle nodes past timeout, launch to fit demand.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .providers import NodeProvider
+
+
+@dataclass
+class NodeType:
+    """Launchable node shape (reference: available_node_types yaml entries).
+
+    ``topology`` labels TPU slices (e.g. {"tpu_slice": "v5e-8", "chips": 8})
+    so mesh claims can demand them.
+    """
+
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+    topology: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: Dict[str, NodeType] = field(default_factory=dict)
+    max_workers: int = 20
+    idle_timeout_s: float = 60.0
+    upscaling_speed: float = 1.0
+
+
+class LoadMetrics:
+    """Demand + utilization snapshot (reference: load_metrics.py)."""
+
+    def __init__(self):
+        self.pending_demands: List[Dict[str, float]] = []
+        self.node_usage: Dict[str, Tuple[Dict[str, float], Dict[str, float]]] = {}
+        self.last_active: Dict[str, float] = {}
+
+    def update_node(self, node_id: str, total: Dict[str, float],
+                    available: Dict[str, float]) -> None:
+        self.node_usage[node_id] = (dict(total), dict(available))
+        busy = any(available.get(k, 0) < v for k, v in total.items())
+        if busy or node_id not in self.last_active:
+            self.last_active[node_id] = time.monotonic()
+
+    def set_pending_demands(self, demands: List[Dict[str, float]]) -> None:
+        self.pending_demands = [dict(d) for d in demands]
+
+    def idle_seconds(self, node_id: str) -> float:
+        return time.monotonic() - self.last_active.get(node_id,
+                                                       time.monotonic())
+
+    @classmethod
+    def from_runtime(cls, runtime) -> "LoadMetrics":
+        """Snapshot a live runtime (the monitor's GCS poll equivalent)."""
+        lm = cls()
+        for node in runtime.scheduler.nodes():
+            lm.update_node(node.node_id.hex(), node.ledger.total,
+                           node.ledger.available)
+        with runtime.scheduler._lock:
+            demands = [dict(l.spec.resources)
+                       for l in runtime.scheduler._queue]
+            demands += [dict(l.spec.resources)
+                        for l in runtime.scheduler._infeasible]
+        lm.set_pending_demands([d for d in demands if d])
+        return lm
+
+
+class ResourceDemandScheduler:
+    """Bin-pack pending demands -> node launches.
+
+    Reference: resource_demand_scheduler.py get_nodes_to_launch — fit each
+    demand onto existing free capacity first, then onto hypothetical new
+    nodes of each type (first type that fits), respecting per-type and
+    global caps.
+    """
+
+    def __init__(self, config: AutoscalerConfig):
+        self.config = config
+
+    def get_nodes_to_launch(
+        self, metrics: LoadMetrics,
+        existing_by_type: Dict[str, int],
+    ) -> Dict[str, int]:
+        free: List[Dict[str, float]] = [
+            dict(avail) for _, avail in metrics.node_usage.values()
+        ]
+        to_launch: Dict[str, int] = {}
+        planned: List[Tuple[str, Dict[str, float]]] = []
+
+        def fits(pool: Dict[str, float], demand: Dict[str, float]) -> bool:
+            return all(pool.get(k, 0.0) >= v for k, v in demand.items())
+
+        def consume(pool: Dict[str, float], demand: Dict[str, float]):
+            for k, v in demand.items():
+                pool[k] = pool.get(k, 0.0) - v
+
+        total_existing = sum(existing_by_type.values())
+        for demand in sorted(metrics.pending_demands,
+                             key=lambda d: -sum(d.values())):
+            placed = False
+            for pool in free:
+                if fits(pool, demand):
+                    consume(pool, demand)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for _, pool in planned:
+                if fits(pool, demand):
+                    consume(pool, demand)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for nt in self.config.node_types.values():
+                count = (existing_by_type.get(nt.name, 0)
+                         + to_launch.get(nt.name, 0))
+                if count >= nt.max_workers:
+                    continue
+                if (total_existing + sum(to_launch.values())
+                        >= self.config.max_workers):
+                    break
+                if fits(dict(nt.resources), demand):
+                    pool = dict(nt.resources)
+                    consume(pool, demand)
+                    planned.append((nt.name, pool))
+                    to_launch[nt.name] = to_launch.get(nt.name, 0) + 1
+                    placed = True
+                    break
+        # min_workers floors.
+        for nt in self.config.node_types.values():
+            have = existing_by_type.get(nt.name, 0) + to_launch.get(nt.name, 0)
+            if have < nt.min_workers:
+                to_launch[nt.name] = (to_launch.get(nt.name, 0)
+                                      + nt.min_workers - have)
+        return to_launch
+
+
+class StandardAutoscaler:
+    """The update loop (reference: autoscaler.py:162 StandardAutoscaler)."""
+
+    def __init__(self, provider: NodeProvider, config: AutoscalerConfig):
+        self.provider = provider
+        self.config = config
+        self.scheduler = ResourceDemandScheduler(config)
+
+    def update(self, metrics: LoadMetrics) -> Dict[str, int]:
+        """One reconcile tick: terminate idle, launch for demand."""
+        nodes = self.provider.non_terminated_nodes()
+        by_type: Dict[str, int] = {}
+        for n in nodes:
+            by_type[n.node_type] = by_type.get(n.node_type, 0) + 1
+        # Scale down: idle past timeout, above min_workers.
+        for n in nodes:
+            nt = self.config.node_types.get(n.node_type)
+            if nt is None:
+                continue
+            if by_type.get(n.node_type, 0) <= nt.min_workers:
+                continue
+            # Provider ids and runtime ids may differ; match by suffix.
+            idle = min(
+                (metrics.idle_seconds(rid) for rid in metrics.node_usage
+                 if n.node_id.endswith(rid[:8]) or rid.startswith(
+                     n.node_id.split("-")[-1])),
+                default=metrics.idle_seconds(n.node_id),
+            )
+            if idle > self.config.idle_timeout_s:
+                self.provider.terminate_node(n.node_id)
+                by_type[n.node_type] -= 1
+        # Scale up.
+        to_launch = self.scheduler.get_nodes_to_launch(metrics, by_type)
+        for node_type, count in to_launch.items():
+            self.provider.create_node(node_type, count)
+        return to_launch

@@ -1,0 +1,106 @@
+"""Node providers: the cloud abstraction behind the autoscaler.
+
+Reference analog: ``autoscaler/node_provider.py`` (NodeProvider plugin API)
++ ``_private/fake_multi_node/node_provider.py:237`` (fake provider driving
+the in-process Cluster for tests — how autoscaler e2e runs without a
+cloud). A GCP-TPU-style provider would map node types to pod-slice
+acceleratorTypes (reference: ``_private/gcp/node.py:187`` GCPTPUNode).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class NodeInstance:
+    node_id: str
+    node_type: str
+    tags: Dict[str, str] = field(default_factory=dict)
+    running: bool = True
+
+
+class NodeProvider:
+    """Plugin API: subclass per cloud."""
+
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        raise NotImplementedError
+
+    def create_node(self, node_type: str, count: int = 1) -> List[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+
+class FakeNodeProvider(NodeProvider):
+    """In-memory provider for pure-logic autoscaler tests."""
+
+    def __init__(self):
+        self._nodes: Dict[str, NodeInstance] = {}
+        self._lock = threading.Lock()
+        self.create_calls: List[tuple] = []
+        self.terminate_calls: List[str] = []
+
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        with self._lock:
+            return [n for n in self._nodes.values() if n.running]
+
+    def create_node(self, node_type: str, count: int = 1) -> List[str]:
+        ids = []
+        with self._lock:
+            for _ in range(count):
+                nid = f"{node_type}-{uuid.uuid4().hex[:8]}"
+                self._nodes[nid] = NodeInstance(nid, node_type)
+                ids.append(nid)
+            self.create_calls.append((node_type, count))
+        return ids
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node:
+                node.running = False
+            self.terminate_calls.append(node_id)
+
+
+class LocalNodeProvider(NodeProvider):
+    """Backs provider nodes with real simulated cluster nodes.
+
+    The e2e analog of the fake multi-node provider: ``create_node`` adds a
+    NodeManager to the live runtime, ``terminate_node`` removes it.
+    """
+
+    def __init__(self, cluster, node_types: Dict[str, "NodeType"]):
+        self._cluster = cluster
+        self._types = node_types
+        self._nodes: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        with self._lock:
+            return [NodeInstance(nid, t) for nid, (t, _) in self._nodes.items()]
+
+    def create_node(self, node_type: str, count: int = 1) -> List[str]:
+        nt = self._types[node_type]
+        out = []
+        for _ in range(count):
+            runtime_node_id = self._cluster.add_node(
+                num_cpus=nt.resources.get("CPU", 1),
+                resources={k: v for k, v in nt.resources.items()
+                           if k != "CPU"},
+            )
+            nid = f"{node_type}-{runtime_node_id.hex()[:8]}"
+            with self._lock:
+                self._nodes[nid] = (node_type, runtime_node_id)
+            out.append(nid)
+        return out
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            entry = self._nodes.pop(node_id, None)
+        if entry is not None:
+            self._cluster.remove_node(entry[1])

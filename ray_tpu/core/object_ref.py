@@ -23,18 +23,25 @@ class ObjectRef:
     pending-task refs, and borrower refs drop (reference_count.h:61).
     """
 
-    __slots__ = ("id", "owner", "_weakref_slot", "__weakref__")
+    __slots__ = ("id", "owner", "_counted", "_weakref_slot", "__weakref__")
 
     def __init__(self, object_id: ObjectID, owner: Optional[bytes] = None,
                  _register: bool = True):
         self.id = object_id
         self.owner = owner  # WorkerID bytes of the owner, None = local runtime
+        # _counted: this ref contributed +1 somewhere and must release it on
+        # GC. Refs created with _register=False stay uncounted unless the
+        # creator marks them (e.g. worker refs whose +1 the owner holds).
+        self._counted = False
         if _register:
             _refcount_hook = _REFCOUNT_HOOKS.get("add")
             if _refcount_hook is not None:
                 _refcount_hook(object_id)
+                self._counted = True
 
     def __del__(self):
+        if not getattr(self, "_counted", False):
+            return
         hook = _REFCOUNT_HOOKS.get("remove")
         if hook is not None:
             try:
@@ -82,6 +89,7 @@ class ObjectRef:
         hook = _REFCOUNT_HOOKS.get("borrow")
         if hook is not None:
             hook(object_id)
+            ref._counted = True
         return ref
 
 

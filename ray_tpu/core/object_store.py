@@ -39,6 +39,21 @@ def _segment_name(object_id: ObjectID) -> str:
     return _SEG_PREFIX + object_id.hex()
 
 
+def arena_name_for(node_id_hex: str) -> str:
+    return f"/rt_arena_{node_id_hex[:16]}"
+
+
+def _try_native():
+    try:
+        from .. import _native
+
+        if _native.available():
+            return _native
+    except Exception:
+        pass
+    return None
+
+
 @dataclass
 class ObjectMeta:
     object_id: ObjectID
@@ -48,6 +63,7 @@ class ObjectMeta:
     spilled_path: Optional[str] = None
     pinned: int = 0
     last_access: float = field(default_factory=time.monotonic)
+    backend: str = "arena"  # arena | segment
 
 
 class SharedMemoryStore:
@@ -70,6 +86,17 @@ class SharedMemoryStore:
         self._spill_dir = spill_dir or os.path.join(
             os.environ.get("TMPDIR", "/tmp"), f"rt_spill_{node_id.hex()[:8]}"
         )
+        # Native arena backend (C++ plasma-equivalent); per-object python
+        # shm segments remain the fallback and the spill format.
+        self._arena = None
+        native = _try_native()
+        if native is not None and os.environ.get("RT_DISABLE_NATIVE_STORE") != "1":
+            try:
+                self._arena = native.NativeStore.create(
+                    arena_name_for(node_id.hex()), self.capacity
+                )
+            except Exception:
+                self._arena = None
 
     # -- create/seal ---------------------------------------------------------
     def put_serialized(self, object_id: ObjectID, obj: SerializedObject) -> ObjectMeta:
@@ -82,28 +109,41 @@ class SharedMemoryStore:
             if object_id in self._meta:
                 return self._meta[object_id]
             self._ensure_capacity(size)
-            seg = shared_memory.SharedMemory(
-                create=True, size=max(size, 1), name=_segment_name(object_id)
-            )
-            seg.buf[:size] = frame
-            meta = ObjectMeta(object_id, size, self.node_id)
+            backend = "segment"
+            if self._arena is not None:
+                self._arena.put(object_id.binary(), frame)
+                backend = "arena"
+            else:
+                seg = shared_memory.SharedMemory(
+                    create=True, size=max(size, 1),
+                    name=_segment_name(object_id)
+                )
+                seg.buf[:size] = frame
+                self._segments[object_id] = seg
+            meta = ObjectMeta(object_id, size, self.node_id, backend=backend)
             self._meta[object_id] = meta
-            self._segments[object_id] = seg
             self.used += size
             return meta
 
     def register_external(self, object_id: ObjectID, size: int) -> ObjectMeta:
-        """Account for a segment created directly by a worker (sealed there)."""
+        """Account for an object sealed directly by a worker."""
         with self._lock:
             if object_id in self._meta:
                 return self._meta[object_id]
-            try:
-                seg = shared_memory.SharedMemory(name=_segment_name(object_id))
-            except FileNotFoundError:
-                raise ObjectLostError(object_id, "worker-created segment vanished")
-            meta = ObjectMeta(object_id, size, self.node_id)
+            backend = "segment"
+            if self._arena is not None and self._arena.contains(
+                    object_id.binary()):
+                backend = "arena"
+            else:
+                try:
+                    seg = shared_memory.SharedMemory(
+                        name=_segment_name(object_id))
+                except FileNotFoundError:
+                    raise ObjectLostError(
+                        object_id, "worker-sealed object vanished")
+                self._segments[object_id] = seg
+            meta = ObjectMeta(object_id, size, self.node_id, backend=backend)
             self._meta[object_id] = meta
-            self._segments[object_id] = seg
             self.used += size
             return meta
 
@@ -120,6 +160,14 @@ class SharedMemoryStore:
             meta.last_access = time.monotonic()
             if meta.spilled_path is not None:
                 self._restore(meta)
+            if meta.backend == "arena" and self._arena is not None:
+                view = self._arena.get(object_id.binary())
+                if view is None:
+                    raise ObjectLostError(object_id)
+                # Unpin immediately: lifetime is governed by our metadata
+                # (delete only runs once refcounts drop, i.e. no readers).
+                self._arena.release(object_id.binary())
+                return view
             seg = self._segments[object_id]
             return memoryview(seg.buf)[: meta.size]
 
@@ -143,14 +191,19 @@ class SharedMemoryStore:
             meta = self._meta.pop(object_id, None)
             if meta is None:
                 return
-            seg = self._segments.pop(object_id, None)
-            if seg is not None:
-                try:
-                    seg.close()
-                    seg.unlink()
-                except FileNotFoundError:
-                    pass
-                self.used -= meta.size
+            if meta.backend == "arena" and self._arena is not None:
+                if meta.spilled_path is None and self._arena.delete(
+                        object_id.binary()):
+                    self.used -= meta.size
+            else:
+                seg = self._segments.pop(object_id, None)
+                if seg is not None:
+                    try:
+                        seg.close()
+                        seg.unlink()
+                    except FileNotFoundError:
+                        pass
+                    self.used -= meta.size
             if meta.spilled_path and os.path.exists(meta.spilled_path):
                 os.unlink(meta.spilled_path)
 
@@ -181,11 +234,20 @@ class SharedMemoryStore:
     def _spill(self, meta: ObjectMeta) -> None:
         os.makedirs(self._spill_dir, exist_ok=True)
         path = os.path.join(self._spill_dir, meta.object_id.hex())
-        seg = self._segments.pop(meta.object_id)
-        with open(path, "wb") as f:
-            f.write(bytes(memoryview(seg.buf)[: meta.size]))
-        seg.close()
-        seg.unlink()
+        if meta.backend == "arena" and self._arena is not None:
+            view = self._arena.get(meta.object_id.binary())
+            if view is None:
+                return
+            with open(path, "wb") as f:
+                f.write(bytes(view))
+            self._arena.release(meta.object_id.binary())
+            self._arena.delete(meta.object_id.binary())
+        else:
+            seg = self._segments.pop(meta.object_id)
+            with open(path, "wb") as f:
+                f.write(bytes(memoryview(seg.buf)[: meta.size]))
+            seg.close()
+            seg.unlink()
         meta.spilled_path = path
         self.used -= meta.size
 
@@ -195,12 +257,15 @@ class SharedMemoryStore:
         with open(path, "rb") as f:
             frame = f.read()
         self._ensure_capacity(len(frame))
-        seg = shared_memory.SharedMemory(
-            create=True, size=max(len(frame), 1),
-            name=_segment_name(meta.object_id),
-        )
-        seg.buf[: len(frame)] = frame
-        self._segments[meta.object_id] = seg
+        if meta.backend == "arena" and self._arena is not None:
+            self._arena.put(meta.object_id.binary(), frame)
+        else:
+            seg = shared_memory.SharedMemory(
+                create=True, size=max(len(frame), 1),
+                name=_segment_name(meta.object_id),
+            )
+            seg.buf[: len(frame)] = frame
+            self._segments[meta.object_id] = seg
         self.used += meta.size
         meta.spilled_path = None
         os.unlink(path)
@@ -210,6 +275,12 @@ class SharedMemoryStore:
         with self._lock:
             for oid in list(self._meta):
                 self.delete(oid)
+            if self._arena is not None:
+                try:
+                    self._arena.close(unlink=True)
+                except Exception:
+                    pass
+                self._arena = None
 
     def stats(self) -> dict:
         with self._lock:
@@ -230,11 +301,31 @@ class ShmClient:
     segments open so zero-copy views stay valid for the process lifetime.
     """
 
-    def __init__(self):
+    def __init__(self, node_id_hex: Optional[str] = None):
         self._attached: Dict[str, shared_memory.SharedMemory] = {}
         self._lock = threading.Lock()
+        self._arena = None
+        self._arenas: Dict[str, object] = {}  # other nodes' arenas by hex
+        self._node_id_hex = node_id_hex
+        self._native = None
+        if os.environ.get("RT_DISABLE_NATIVE_STORE") != "1":
+            self._native = _try_native()
+        if node_id_hex and self._native is not None:
+            try:
+                self._arena = self._native.NativeStore.attach(
+                    arena_name_for(node_id_hex)
+                )
+                self._arenas[node_id_hex] = self._arena
+            except Exception:
+                self._arena = None
 
     def create_and_seal(self, object_id: ObjectID, frame: bytes) -> int:
+        if self._arena is not None:
+            try:
+                self._arena.put(object_id.binary(), frame)
+                return len(frame)
+            except Exception:
+                pass  # arena full/unavailable: fall back to a segment
         seg = shared_memory.SharedMemory(
             create=True, size=max(len(frame), 1), name=_segment_name(object_id)
         )
@@ -243,7 +334,30 @@ class ShmClient:
             self._attached[_segment_name(object_id)] = seg
         return len(frame)
 
-    def read(self, object_id: ObjectID, size: int) -> memoryview:
+    def _arena_for(self, node_hex: Optional[str]):
+        if self._native is None:
+            return None
+        if node_hex is None:
+            return self._arena
+        arena = self._arenas.get(node_hex)
+        if arena is None:
+            try:
+                arena = self._native.NativeStore.attach(
+                    arena_name_for(node_hex))
+            except Exception:
+                arena = False  # negative-cache
+            self._arenas[node_hex] = arena
+        return arena or None
+
+    def read(self, object_id: ObjectID, size: int,
+             node_hex: Optional[str] = None) -> memoryview:
+        for arena in (self._arena_for(node_hex), self._arena):
+            if arena is not None:
+                view = arena.get(object_id.binary())
+                if view is not None:
+                    # Pin stays for the worker's lifetime: zero-copy views
+                    # may back live numpy arrays in user code.
+                    return view
         name = _segment_name(object_id)
         with self._lock:
             seg = self._attached.get(name)
@@ -260,6 +374,12 @@ class ShmClient:
                 except Exception:
                     pass
             self._attached.clear()
+        if self._arena is not None:
+            try:
+                self._arena.close(unlink=False)
+            except Exception:
+                pass
+            self._arena = None
 
 
 class MemoryStore:

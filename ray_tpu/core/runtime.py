@@ -331,7 +331,7 @@ class Runtime:
         if entry.location[0] == "memory":
             return ("inline", self.memory_store.get(oid))
         _, node_id, size = entry.location
-        return ("shm", (oid.binary(), size))
+        return ("shm", (oid.binary(), size, node_id.hex()))
 
     # ------------------------------------------------------------------ wait
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
@@ -763,6 +763,12 @@ class Runtime:
         kind = msg[0]
         if kind == "register":
             return
+        if kind == "refadd":
+            self._ref_added(ObjectID(msg[1]))
+            return
+        if kind == "refdel":
+            self._ref_removed(ObjectID(msg[1]))
+            return
         if kind == "done":
             _, task_id_hex, results = msg
             task_id = TaskID.from_hex(task_id_hex)
@@ -894,6 +900,10 @@ class Runtime:
                 _, _, spec_blob = msg
                 spec = serialization.loads(spec_blob)
                 refs = self.submit_spec(spec)
+                # Pin each return on the borrower's behalf BEFORE our local
+                # temp refs are GC'd; the worker's refdel releases this.
+                for r in refs:
+                    self._ref_added(r.id)
                 worker.send(("reply", req_id, True,
                              [r.id.binary() for r in refs]))
             elif kind == "kill_actor":

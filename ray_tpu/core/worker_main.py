@@ -55,7 +55,7 @@ class WorkerRuntime:
         self.conn = conn
         self.worker_id_hex = worker_id_hex
         self.node_id_hex = node_id_hex
-        self.shm = ShmClient()
+        self.shm = ShmClient(node_id_hex)
         self.serializer = Serializer(ref_class=ObjectRef)
         self._send_lock = threading.Lock()
         self._pending_rpcs: Dict[int, Future] = {}
@@ -67,7 +67,24 @@ class WorkerRuntime:
         self._shutdown = threading.Event()
         self.current_task_id: Optional[TaskID] = None
         self._put_counter = 0
-        install_refcount_hooks()  # no-op hooks in workers; owner tracks refs
+        # Borrower protocol (reference_count.h borrower reports): every ref
+        # held in this worker pins the object at the owner; GC of the local
+        # ref releases the pin via a fire-and-forget message.
+        install_refcount_hooks(
+            add=self._ref_add, remove=self._ref_del, borrow=self._ref_add
+        )
+
+    def _ref_add(self, oid) -> None:
+        try:
+            self._send(("refadd", oid.binary()))
+        except Exception:
+            pass
+
+    def _ref_del(self, oid) -> None:
+        try:
+            self._send(("refdel", oid.binary()))
+        except Exception:
+            pass
 
     # -- transport -----------------------------------------------------------
     def _send(self, msg) -> None:
@@ -134,7 +151,9 @@ class WorkerRuntime:
         else:
             self.shm.create_and_seal(object_id, frame)
             oid_bin = self._rpc("put", object_id.binary(), ("shm", len(frame)))
-        return ObjectRef(ObjectID(oid_bin))
+        ref = ObjectRef(ObjectID(oid_bin), _register=False)
+        ref._counted = True  # head's put handler took the +1
+        return ref
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         ids = [r.id.binary() for r in refs]
@@ -145,9 +164,19 @@ class WorkerRuntime:
         return ready, not_ready
 
     def submit_task(self, spec_blob: bytes):
-        """Nested task/actor submission; owner stays the head runtime (v1)."""
+        """Nested task/actor submission; owner stays the head runtime (v1).
+
+        The head pins each return id on this worker's behalf before
+        replying, so the refs are constructed unregistered-but-counted:
+        their GC sends the matching release.
+        """
         return_bins = self._rpc("submit", spec_blob)
-        return [ObjectRef(ObjectID(b)) for b in return_bins]
+        refs = []
+        for b in return_bins:
+            ref = ObjectRef(ObjectID(b), _register=False)
+            ref._counted = True
+            refs.append(ref)
+        return refs
 
     def submit_spec(self, spec):
         return self.submit_task(serialization.dumps(spec))
@@ -163,8 +192,9 @@ class WorkerRuntime:
         if kind == "inline":
             return self.serializer.deserialize(payload)
         if kind == "shm":
-            oid_bin, size = payload
-            view = self.shm.read(ObjectID(oid_bin), size)
+            oid_bin, size = payload[0], payload[1]
+            node_hex = payload[2] if len(payload) > 2 else None
+            view = self.shm.read(ObjectID(oid_bin), size, node_hex)
             return self.serializer.deserialize(view)
         if kind == "error":
             return payload

@@ -1,0 +1,19 @@
+"""RLlib-equivalent: reinforcement learning with JAX policies on TPU
+learners + CPU rollout actors.
+
+Reference analog: ``rllib/`` (Algorithm/AlgorithmConfig, PPO,
+RolloutWorker/WorkerSet, SampleBatch, env abstractions).
+"""
+
+from .algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from .env import FastCartPole, GymVectorEnv, VectorEnv, make_env
+from .policy import JaxPolicy
+from .ppo import PPO, PPOConfig
+from .rollout_worker import RolloutWorker
+from .sample_batch import SampleBatch, compute_gae
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "FastCartPole", "GymVectorEnv",
+    "JaxPolicy", "PPO", "PPOConfig", "RolloutWorker", "SampleBatch",
+    "VectorEnv", "WorkerSet", "compute_gae", "make_env",
+]

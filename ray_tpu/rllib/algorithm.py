@@ -1,0 +1,225 @@
+"""Algorithm base + AlgorithmConfig.
+
+Reference analog: ``rllib/algorithms/algorithm.py:144`` (Algorithm extends
+the Tune Trainable: ``setup`` :334 builds the WorkerSet, ``training_step``
+:1161 is per-algorithm) and ``algorithm_config.py`` (fluent config).
+
+The Algorithm here exposes the Trainable-style surface (train/save/restore)
+and plugs into Tune via ``as_trainable``.
+"""
+
+from __future__ import annotations
+
+import copy
+import pickle
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core import get, kill, remote
+from .rollout_worker import RolloutWorker
+from .sample_batch import SampleBatch
+
+
+class AlgorithmConfig:
+    """Fluent config (reference: AlgorithmConfig.environment/rollouts/...)."""
+
+    def __init__(self):
+        self.env: Any = "FastCartPole"
+        self.num_rollout_workers: int = 0
+        self.num_envs_per_worker: int = 8
+        self.rollout_fragment_length: int = 128
+        self.gamma: float = 0.99
+        self.lr: float = 3e-4
+        self.train_batch_size: int = 2048
+        self.seed: int = 0
+        self.policy_hidden: tuple = (64, 64)
+        self.extra: Dict[str, Any] = {}
+
+    def environment(self, env: Any = None, **kwargs) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        self.extra.update(kwargs)
+        return self
+
+    def rollouts(self, num_rollout_workers: Optional[int] = None,
+                 num_envs_per_worker: Optional[int] = None,
+                 rollout_fragment_length: Optional[int] = None
+                 ) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, lr: Optional[float] = None,
+                 gamma: Optional[float] = None,
+                 train_batch_size: Optional[int] = None,
+                 **kwargs) -> "AlgorithmConfig":
+        if lr is not None:
+            self.lr = lr
+        if gamma is not None:
+            self.gamma = gamma
+        if train_batch_size is not None:
+            self.train_batch_size = train_batch_size
+        self.extra.update(kwargs)
+        return self
+
+    def debugging(self, seed: Optional[int] = None, **kwargs
+                  ) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self) -> "Algorithm":
+        algo_cls = getattr(self, "_algo_class", None)
+        if algo_cls is None:
+            raise ValueError("use a concrete config (e.g. PPOConfig)")
+        return algo_cls(self)
+
+
+class WorkerSet:
+    """Learner-side view of the rollout actors.
+
+    Reference: ``rllib/evaluation/worker_set.py`` — local worker +
+    remote workers; ``sync_weights`` (:205) broadcasts learner weights.
+    """
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.local_worker = RolloutWorker(
+            config.env, config.num_envs_per_worker,
+            {"hidden": config.policy_hidden}, seed=config.seed,
+        )
+        self.remote_workers: List[Any] = []
+        if config.num_rollout_workers > 0:
+            worker_cls = remote(RolloutWorker)
+            self.remote_workers = [
+                worker_cls.options(num_cpus=1).remote(
+                    config.env, config.num_envs_per_worker,
+                    {"hidden": config.policy_hidden},
+                    seed=config.seed, worker_index=i + 1,
+                )
+                for i in range(config.num_rollout_workers)
+            ]
+
+    def sync_weights(self, weights: Dict) -> None:
+        if self.remote_workers:
+            from ..core import put
+
+            ref = put(weights)  # one copy in the object store, N readers
+            get([w.set_weights.remote(ref) for w in self.remote_workers])
+
+    def sample(self, rollout_length: int) -> List[SampleBatch]:
+        if self.remote_workers:
+            return get([w.sample.remote(rollout_length)
+                        for w in self.remote_workers])
+        return [self.local_worker.sample(rollout_length)]
+
+    def episode_stats(self) -> List[Dict]:
+        if self.remote_workers:
+            return get([w.episode_stats.remote()
+                        for w in self.remote_workers])
+        return [self.local_worker.episode_stats()]
+
+    def stop(self) -> None:
+        for w in self.remote_workers:
+            try:
+                kill(w)
+            except Exception:
+                pass
+
+
+class Algorithm:
+    """Trainable-style base (train/save/restore/stop)."""
+
+    def __init__(self, config: AlgorithmConfig):
+        from ..core import runtime as runtime_mod
+
+        runtime_mod.auto_init()
+        self.config = config
+        self.iteration = 0
+        self._timesteps_total = 0
+        self.setup(config)
+
+    def setup(self, config: AlgorithmConfig) -> None:
+        self.workers = WorkerSet(config)
+
+    def training_step(self) -> Dict:
+        raise NotImplementedError
+
+    def train(self) -> Dict:
+        """One training iteration (reference: Trainable.train -> step)."""
+        t0 = time.perf_counter()
+        result = self.training_step()
+        self.iteration += 1
+        elapsed = time.perf_counter() - t0
+        stats = [s for s in self.workers.episode_stats()]
+        rewards = [s["episode_reward_mean"] for s in stats
+                   if s.get("episode_reward_mean") is not None]
+        result.update({
+            "training_iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+            "time_this_iter_s": elapsed,
+            "env_steps_per_sec": result.get("timesteps_this_iter", 0) / max(
+                elapsed, 1e-9),
+        })
+        if rewards:
+            result["episode_reward_mean"] = float(sum(rewards) / len(rewards))
+        return result
+
+    def save(self, path: str) -> str:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        state = self.get_state()
+        file = os.path.join(path, "algorithm_state.pkl")
+        with open(file, "wb") as f:
+            pickle.dump(state, f)
+        return file
+
+    def restore(self, path: str) -> None:
+        import os
+
+        file = (path if path.endswith(".pkl")
+                else os.path.join(path, "algorithm_state.pkl"))
+        with open(file, "rb") as f:
+            state = pickle.load(f)
+        self.set_state(state)
+
+    def get_state(self) -> Dict:
+        return {"iteration": self.iteration,
+                "timesteps_total": self._timesteps_total}
+
+    def set_state(self, state: Dict) -> None:
+        self.iteration = state.get("iteration", 0)
+        self._timesteps_total = state.get("timesteps_total", 0)
+
+    def stop(self) -> None:
+        self.workers.stop()
+
+    @classmethod
+    def as_trainable(cls, base_config: AlgorithmConfig,
+                     stop_iters: int = 10) -> Callable:
+        """Adapt to the Tune layer (Algorithm IS a Trainable in the
+        reference; here a function trainable wraps the step loop)."""
+
+        def trainable(tune_config: Dict):
+            from ..tune import report
+
+            config = base_config.copy()
+            for k, v in tune_config.items():
+                setattr(config, k, v)
+            algo = cls(config)
+            try:
+                for _ in range(stop_iters):
+                    report(algo.train())
+            finally:
+                algo.stop()
+
+        return trainable

@@ -1,0 +1,146 @@
+"""Environment abstractions: vectorized env over gymnasium + native envs.
+
+Reference analog: ``rllib/env/`` (BaseEnv/VectorEnv wrapping gym). A
+``VectorEnv`` steps N env copies with batched numpy IO — the rollout hot
+loop's interface. ``FastCartPole`` is a pure-numpy vectorized CartPole used
+for throughput benchmarking without per-env python loops (the env analog of
+the reference's Atari throughput configs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+
+class VectorEnv:
+    """N synchronized env copies; batched reset/step."""
+
+    num_envs: int
+    observation_space_shape: Tuple[int, ...]
+    num_actions: int
+
+    def vector_reset(self, seed: Optional[int] = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def vector_step(self, actions: np.ndarray
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, dict]:
+        """-> (obs [N, ...], rewards [N], dones [N], info). Auto-resets
+        done sub-envs (returned obs is the fresh reset obs)."""
+        raise NotImplementedError
+
+
+class GymVectorEnv(VectorEnv):
+    """Wraps ``gymnasium.make_vec``-style env batches."""
+
+    def __init__(self, env_id: str, num_envs: int = 1, **kwargs):
+        import gymnasium as gym
+
+        self._envs = [gym.make(env_id, **kwargs) for _ in range(num_envs)]
+        self.num_envs = num_envs
+        space = self._envs[0].observation_space
+        self.observation_space_shape = tuple(space.shape)
+        self.num_actions = int(self._envs[0].action_space.n)
+
+    def vector_reset(self, seed: Optional[int] = None) -> np.ndarray:
+        obs = []
+        for i, e in enumerate(self._envs):
+            o, _ = e.reset(seed=None if seed is None else seed + i)
+            obs.append(o)
+        return np.stack(obs)
+
+    def vector_step(self, actions):
+        obs, rewards, dones = [], [], []
+        for e, a in zip(self._envs, actions):
+            o, r, term, trunc, _ = e.step(int(a))
+            done = bool(term or trunc)
+            if done:
+                o, _ = e.reset()
+            obs.append(o)
+            rewards.append(r)
+            dones.append(done)
+        return (np.stack(obs), np.asarray(rewards, np.float32),
+                np.asarray(dones), {})
+
+
+class FastCartPole(VectorEnv):
+    """Vectorized numpy CartPole-v1 (identical dynamics/termination).
+
+    One batched numpy update per step for all N envs — the high-throughput
+    path for the env-steps/sec benchmark.
+    """
+
+    GRAVITY = 9.8
+    MASS_CART = 1.0
+    MASS_POLE = 0.1
+    LENGTH = 0.5
+    FORCE = 10.0
+    TAU = 0.02
+    THETA_LIMIT = 12 * 2 * np.pi / 360
+    X_LIMIT = 2.4
+    MAX_STEPS = 500
+
+    def __init__(self, num_envs: int = 1, seed: int = 0):
+        self.num_envs = num_envs
+        self.observation_space_shape = (4,)
+        self.num_actions = 2
+        self._rng = np.random.default_rng(seed)
+        self._state = np.zeros((num_envs, 4), np.float32)
+        self._steps = np.zeros(num_envs, np.int32)
+
+    def _reset_some(self, mask: np.ndarray) -> None:
+        n = int(mask.sum())
+        if n:
+            self._state[mask] = self._rng.uniform(
+                -0.05, 0.05, (n, 4)
+            ).astype(np.float32)
+            self._steps[mask] = 0
+
+    def vector_reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._reset_some(np.ones(self.num_envs, bool))
+        return self._state.copy()
+
+    def vector_step(self, actions):
+        x, x_dot, theta, theta_dot = self._state.T
+        force = np.where(actions == 1, self.FORCE, -self.FORCE)
+        costh, sinth = np.cos(theta), np.sin(theta)
+        total_mass = self.MASS_CART + self.MASS_POLE
+        polemass_length = self.MASS_POLE * self.LENGTH
+        temp = (force + polemass_length * theta_dot**2 * sinth) / total_mass
+        theta_acc = (self.GRAVITY * sinth - costh * temp) / (
+            self.LENGTH * (4.0 / 3.0 - self.MASS_POLE * costh**2 / total_mass)
+        )
+        x_acc = temp - polemass_length * theta_acc * costh / total_mass
+        x = x + self.TAU * x_dot
+        x_dot = x_dot + self.TAU * x_acc
+        theta = theta + self.TAU * theta_dot
+        theta_dot = theta_dot + self.TAU * theta_acc
+        self._state = np.stack([x, x_dot, theta, theta_dot], axis=1).astype(
+            np.float32
+        )
+        self._steps += 1
+        done = (
+            (np.abs(x) > self.X_LIMIT)
+            | (np.abs(theta) > self.THETA_LIMIT)
+            | (self._steps >= self.MAX_STEPS)
+        )
+        rewards = np.ones(self.num_envs, np.float32)
+        self._reset_some(done)
+        return self._state.copy(), rewards, done, {}
+
+
+def make_env(env: Any, num_envs: int, seed: int = 0) -> VectorEnv:
+    """Resolve an env spec: VectorEnv instance, factory, or gym id."""
+    if isinstance(env, VectorEnv):
+        return env
+    if callable(env):
+        made = env(num_envs)
+        if isinstance(made, VectorEnv):
+            return made
+        raise TypeError("env factory must return a VectorEnv")
+    if env == "FastCartPole":
+        return FastCartPole(num_envs, seed)
+    return GymVectorEnv(env, num_envs)

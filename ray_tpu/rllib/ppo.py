@@ -1,0 +1,203 @@
+"""PPO: clipped-surrogate policy optimization with a JAX learner.
+
+Reference analog: ``rllib/algorithms/ppo/ppo.py:47,289,401`` —
+``training_step`` = synchronous_parallel_sample → train_one_step →
+sync_weights (SURVEY §3.6). TPU re-design: the whole SGD phase (epochs x
+minibatches of the clipped surrogate + value + entropy loss) is ONE
+jit-compiled program (``lax.scan`` over minibatches inside ``lax.scan``
+over epochs) running on the accelerator; rollouts stay on CPU actors.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .policy import forward_mlp, init_mlp_policy
+from .sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    LOGPS,
+    OBS,
+    VALUE_TARGETS,
+    SampleBatch,
+    compute_gae,
+    flatten_time_major,
+)
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self._algo_class = PPO
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.num_sgd_iter = 8
+        self.sgd_minibatch_size = 256
+        self.lambda_ = 0.95
+        self.grad_clip = 0.5
+
+    def training(self, clip_param=None, vf_loss_coeff=None,
+                 entropy_coeff=None, num_sgd_iter=None,
+                 sgd_minibatch_size=None, lambda_=None, **kwargs
+                 ) -> "PPOConfig":
+        super().training(**kwargs)
+        for name, val in [("clip_param", clip_param),
+                          ("vf_loss_coeff", vf_loss_coeff),
+                          ("entropy_coeff", entropy_coeff),
+                          ("num_sgd_iter", num_sgd_iter),
+                          ("sgd_minibatch_size", sgd_minibatch_size),
+                          ("lambda_", lambda_)]:
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+def ppo_loss(params, batch, clip_param, vf_clip, vf_coeff, ent_coeff):
+    logits, values = forward_mlp(params, batch[OBS])
+    logp_all = jax.nn.log_softmax(logits)
+    actions = batch[ACTIONS].astype(jnp.int32)
+    logp = jnp.take_along_axis(logp_all, actions[:, None], axis=-1)[:, 0]
+    ratio = jnp.exp(logp - batch[LOGPS])
+    adv = batch[ADVANTAGES]
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    surrogate = jnp.minimum(
+        ratio * adv,
+        jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv,
+    )
+    policy_loss = -jnp.mean(surrogate)
+    vf_err = jnp.clip(values - batch[VALUE_TARGETS], -vf_clip, vf_clip)
+    vf_loss = jnp.mean(vf_err ** 2)
+    entropy = -jnp.mean(
+        jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+    )
+    total = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
+    return total, {
+        "policy_loss": policy_loss, "vf_loss": vf_loss, "entropy": entropy,
+        "kl": jnp.mean(batch[LOGPS] - logp),
+    }
+
+
+def build_ppo_update(config: PPOConfig, optimizer):
+    """One compiled program: epochs x minibatches of SGD.
+
+    The minibatch schedule is a static reshape + permutation consumed by
+    nested ``lax.scan`` — no per-minibatch dispatch from the host.
+    """
+    clip, vfc, vco, eco = (config.clip_param, config.vf_clip_param,
+                           config.vf_loss_coeff, config.entropy_coeff)
+    mb_size = config.sgd_minibatch_size
+    epochs = config.num_sgd_iter
+
+    @jax.jit
+    def update(params, opt_state, batch, rng):
+        n = batch[OBS].shape[0]
+        num_mb = max(1, n // mb_size)
+        usable = num_mb * mb_size
+
+        def epoch_body(carry, epoch_rng):
+            params, opt_state = carry
+            perm = jax.random.permutation(epoch_rng, n)[:usable]
+            shuffled = {k: v[perm] for k, v in batch.items()}
+            mbs = {
+                k: v.reshape((num_mb, mb_size) + v.shape[1:])
+                for k, v in shuffled.items()
+            }
+
+            def mb_body(carry, mb):
+                params, opt_state = carry
+                (loss, aux), grads = jax.value_and_grad(
+                    ppo_loss, has_aux=True
+                )(params, mb, clip, vfc, vco, eco)
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), (loss, aux)
+
+            (params, opt_state), (losses, auxs) = jax.lax.scan(
+                mb_body, (params, opt_state), mbs
+            )
+            return (params, opt_state), (losses[-1], jax.tree.map(
+                lambda a: a[-1], auxs))
+
+        rngs = jax.random.split(rng, epochs)
+        (params, opt_state), (losses, auxs) = jax.lax.scan(
+            epoch_body, (params, opt_state), rngs
+        )
+        metrics = {"total_loss": losses[-1]}
+        metrics.update({k: v[-1] for k, v in auxs.items()})
+        return params, opt_state, metrics
+
+    return update
+
+
+class PPO(Algorithm):
+    def setup(self, config: PPOConfig) -> None:
+        super().setup(config)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip),
+            optax.adam(config.lr),
+        )
+        # Learner-side copy of the policy params lives on the accelerator.
+        self.params = jax.tree.map(
+            jnp.asarray, self.workers.local_worker.policy.params
+        )
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = build_ppo_update(config, self.optimizer)
+        self._rng = jax.random.PRNGKey(config.seed)
+        self.workers.sync_weights(jax.tree.map(np.asarray, self.params))
+
+    def training_step(self) -> Dict:
+        """sample -> GAE -> compiled SGD -> weight broadcast (SURVEY §3.6)."""
+        cfg: PPOConfig = self.config
+        fragments = self.workers.sample(cfg.rollout_fragment_length)
+        processed = []
+        for frag in fragments:
+            last_values = frag.pop("last_values")
+            frag = compute_gae(frag, last_values, cfg.gamma, cfg.lambda_)
+            processed.append(flatten_time_major(frag))
+        train_batch = SampleBatch.concat_samples(processed)
+        steps = train_batch.count
+        self._timesteps_total += steps
+
+        device_batch = {
+            k: jnp.asarray(v) for k, v in train_batch.items()
+            if k in (OBS, ACTIONS, LOGPS, ADVANTAGES, VALUE_TARGETS)
+        }
+        self._rng, sub = jax.random.split(self._rng)
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, device_batch, sub
+        )
+        weights = jax.tree.map(np.asarray, self.params)
+        self.workers.local_worker.set_weights(weights)
+        self.workers.sync_weights(weights)
+        out = {k: float(v) for k, v in metrics.items()}
+        out["timesteps_this_iter"] = steps
+        return out
+
+    def get_state(self) -> Dict:
+        state = super().get_state()
+        state["params"] = jax.tree.map(np.asarray, self.params)
+        return state
+
+    def set_state(self, state: Dict) -> None:
+        super().set_state(state)
+        if "params" in state:
+            self.params = jax.tree.map(jnp.asarray, state["params"])
+            weights = jax.tree.map(np.asarray, self.params)
+            self.workers.local_worker.set_weights(weights)
+            self.workers.sync_weights(weights)
+
+    def compute_single_action(self, obs, deterministic: bool = True):
+        actions, _, _ = self.workers.local_worker.policy.compute_actions(
+            np.asarray(obs)[None], deterministic=deterministic
+        )
+        return int(actions[0])

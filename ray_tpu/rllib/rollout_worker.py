@@ -1,0 +1,103 @@
+"""RolloutWorker: env-sampling actor.
+
+Reference analog: ``rllib/evaluation/rollout_worker.py:124`` with the
+``SyncSampler`` env loop (``sampler.py:145,546``) — collects fixed-length
+time-major rollout fragments from a vectorized env using the current policy
+weights; weights are synced from the learner each iteration
+(``WorkerSet.sync_weights``, worker_set.py:205).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .env import make_env
+from .policy import JaxPolicy
+from .sample_batch import (
+    ACTIONS,
+    DONES,
+    LOGPS,
+    OBS,
+    REWARDS,
+    VF_PREDS,
+    SampleBatch,
+)
+
+
+class RolloutWorker:
+    """Actor body (also usable inline for num_workers=0 local mode)."""
+
+    def __init__(self, env_spec: Any, num_envs: int = 1,
+                 policy_config: Optional[Dict] = None, seed: int = 0,
+                 worker_index: int = 0):
+        import jax
+
+        # Rollout workers always run CPU inference — the learner owns the
+        # accelerator (reference: rollout workers are CPU actors).
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        self.env = make_env(env_spec, num_envs, seed + worker_index * 1000)
+        cfg = policy_config or {}
+        self.policy = JaxPolicy(
+            self.env.observation_space_shape, self.env.num_actions,
+            hidden=cfg.get("hidden", (64, 64)),
+            seed=seed + worker_index,
+        )
+        self._obs = self.env.vector_reset(seed=seed + worker_index * 1000)
+        self._episode_rewards = np.zeros(self.env.num_envs, np.float32)
+        self._completed: list = []
+        self.worker_index = worker_index
+
+    def set_weights(self, weights: Dict) -> None:
+        self.policy.set_weights(weights)
+
+    def get_weights(self) -> Dict:
+        return self.policy.get_weights()
+
+    def sample(self, rollout_length: int = 128) -> SampleBatch:
+        """Collect a [T, N, ...] fragment; auto-resetting envs."""
+        n = self.env.num_envs
+        obs_buf = np.empty((rollout_length, n) +
+                           tuple(self.env.observation_space_shape),
+                           np.float32)
+        act_buf = np.empty((rollout_length, n), np.int32)
+        logp_buf = np.empty((rollout_length, n), np.float32)
+        vf_buf = np.empty((rollout_length, n), np.float32)
+        rew_buf = np.empty((rollout_length, n), np.float32)
+        done_buf = np.empty((rollout_length, n), bool)
+        for t in range(rollout_length):
+            actions, logp, values = self.policy.compute_actions(self._obs)
+            obs_buf[t] = self._obs
+            act_buf[t] = actions
+            logp_buf[t] = logp
+            vf_buf[t] = values
+            next_obs, rewards, dones, _ = self.env.vector_step(actions)
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+            self._episode_rewards += rewards
+            for i in np.nonzero(dones)[0]:
+                self._completed.append(float(self._episode_rewards[i]))
+                self._episode_rewards[i] = 0.0
+            self._obs = next_obs
+        # Bootstrap values for the final observation.
+        _, _, last_values = self.policy.compute_actions(self._obs)
+        batch = SampleBatch({
+            OBS: obs_buf, ACTIONS: act_buf, LOGPS: logp_buf,
+            VF_PREDS: vf_buf, REWARDS: rew_buf, DONES: done_buf,
+        })
+        batch["last_values"] = np.asarray(last_values, np.float32)
+        return batch
+
+    def episode_stats(self, clear: bool = True) -> Dict:
+        eps = list(self._completed)
+        if clear:
+            self._completed = []
+        return {
+            "episodes": len(eps),
+            "episode_reward_mean": float(np.mean(eps)) if eps else None,
+            "episode_reward_max": float(np.max(eps)) if eps else None,
+        }

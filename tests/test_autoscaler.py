@@ -1,0 +1,111 @@
+"""Autoscaler tests: bin-packing logic + e2e with the local provider.
+
+Mirrors reference coverage: ``tests/test_resource_demand_scheduler.py``
+(pure bin-packing), ``tests/test_autoscaler.py`` (mocked provider),
+``tests/test_autoscaler_fake_multinode.py`` (e2e).
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.autoscaler import (
+    AutoscalerConfig,
+    FakeNodeProvider,
+    LoadMetrics,
+    NodeType,
+    ResourceDemandScheduler,
+    StandardAutoscaler,
+)
+
+
+def _config():
+    return AutoscalerConfig(node_types={
+        "cpu4": NodeType("cpu4", {"CPU": 4}, max_workers=5),
+        "tpu8": NodeType("tpu8", {"CPU": 8, "TPU": 8}, max_workers=2,
+                         topology={"tpu_slice": "v5e-8", "chips": 8}),
+    }, max_workers=6, idle_timeout_s=0.2)
+
+
+def test_bin_packing_launches_for_demand():
+    sched = ResourceDemandScheduler(_config())
+    metrics = LoadMetrics()
+    metrics.set_pending_demands([{"CPU": 2}] * 4)  # 8 CPUs wanted
+    out = sched.get_nodes_to_launch(metrics, {})
+    assert out == {"cpu4": 2}  # two 4-CPU nodes pack 4x2-CPU demands
+
+
+def test_bin_packing_uses_existing_capacity():
+    sched = ResourceDemandScheduler(_config())
+    metrics = LoadMetrics()
+    metrics.update_node("n1", {"CPU": 4}, {"CPU": 4})  # 4 CPUs free
+    metrics.set_pending_demands([{"CPU": 2}, {"CPU": 2}])
+    out = sched.get_nodes_to_launch(metrics, {"cpu4": 1})
+    assert out == {}  # fits in the free node
+
+
+def test_tpu_demand_selects_tpu_type():
+    sched = ResourceDemandScheduler(_config())
+    metrics = LoadMetrics()
+    metrics.set_pending_demands([{"TPU": 8}])
+    out = sched.get_nodes_to_launch(metrics, {})
+    assert out == {"tpu8": 1}
+
+
+def test_max_workers_cap():
+    sched = ResourceDemandScheduler(_config())
+    metrics = LoadMetrics()
+    metrics.set_pending_demands([{"CPU": 4}] * 20)
+    out = sched.get_nodes_to_launch(metrics, {})
+    assert sum(out.values()) <= 6
+
+
+def test_min_workers_floor():
+    cfg = AutoscalerConfig(node_types={
+        "base": NodeType("base", {"CPU": 2}, min_workers=2),
+    })
+    sched = ResourceDemandScheduler(cfg)
+    out = sched.get_nodes_to_launch(LoadMetrics(), {})
+    assert out == {"base": 2}
+
+
+def test_standard_autoscaler_scales_up_and_down():
+    provider = FakeNodeProvider()
+    autoscaler = StandardAutoscaler(provider, _config())
+    metrics = LoadMetrics()
+    metrics.set_pending_demands([{"CPU": 3}])
+    autoscaler.update(metrics)
+    assert len(provider.non_terminated_nodes()) == 1
+    # Demand satisfied; node reported idle -> terminated after timeout.
+    nid = provider.non_terminated_nodes()[0].node_id
+    metrics.set_pending_demands([])
+    metrics.update_node(nid, {"CPU": 4}, {"CPU": 4})
+    metrics.last_active[nid] = time.monotonic() - 10  # long idle
+    autoscaler.update(metrics)
+    assert len(provider.non_terminated_nodes()) == 0
+
+
+def test_autoscaler_e2e_with_cluster(rt_cluster):
+    """Infeasible task -> autoscaler launches a real node -> task runs."""
+    import ray_tpu as rt
+    from ray_tpu.autoscaler.providers import LocalNodeProvider
+
+    cluster = rt_cluster
+    cfg = AutoscalerConfig(node_types={
+        "accel": NodeType("accel", {"CPU": 2, "accel": 1}, max_workers=2),
+    }, idle_timeout_s=999)
+    provider = LocalNodeProvider(cluster, cfg.node_types)
+    autoscaler = StandardAutoscaler(provider, cfg)
+
+    @rt.remote(resources={"accel": 1})
+    def needs_accel():
+        return "scaled!"
+
+    ref = needs_accel.remote()
+    ready, _ = rt.wait([ref], timeout=0.5)
+    assert not ready  # infeasible on the head node
+    metrics = LoadMetrics.from_runtime(cluster.runtime)
+    assert metrics.pending_demands
+    launched = autoscaler.update(metrics)
+    assert launched == {"accel": 1}
+    assert rt.get(ref, timeout=60) == "scaled!"

@@ -1,0 +1,106 @@
+"""Native shm arena store tests: C++ allocator + multiprocess access."""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._native import NativeStore, NativeStoreFull, available
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="native store unavailable")
+
+
+def _key(i: int) -> bytes:
+    return i.to_bytes(4, "little") + b"\x00" * 16
+
+
+def test_put_get_roundtrip():
+    store = NativeStore.create("/rt_test_a", 4 * 1024 * 1024)
+    try:
+        data = os.urandom(1000)
+        store.put(_key(1), data)
+        view = store.get(_key(1))
+        assert bytes(view) == data
+        store.release(_key(1))
+        assert store.contains(_key(1))
+        assert not store.contains(_key(2))
+    finally:
+        store.close()
+
+
+def test_delete_and_reuse_space():
+    store = NativeStore.create("/rt_test_b", 1024 * 1024)
+    try:
+        big = b"x" * (600 * 1024)
+        store.put(_key(1), big)
+        with pytest.raises(NativeStoreFull):
+            store.put(_key(2), big)
+        assert store.delete(_key(1))
+        store.put(_key(2), big)  # space reclaimed after free+coalesce
+        assert store.contains(_key(2))
+    finally:
+        store.close()
+
+
+def test_many_objects_alloc_free():
+    store = NativeStore.create("/rt_test_c", 8 * 1024 * 1024)
+    try:
+        for i in range(500):
+            store.put(_key(i), bytes([i % 256]) * (1000 + i))
+        stats = store.stats()
+        assert stats["num_objects"] == 500
+        for i in range(0, 500, 2):
+            store.delete(_key(i))
+        assert store.stats()["num_objects"] == 250
+        for i in range(500, 700):
+            store.put(_key(i), b"y" * 2000)
+        for i in range(1, 500, 2):
+            assert bytes(store.get(_key(i))[:1]) == bytes([i % 256])
+            store.release(_key(i))
+    finally:
+        store.close()
+
+
+def _child_process(name, n):
+    from ray_tpu._native import NativeStore
+
+    store = NativeStore.attach(name)
+    for i in range(n):
+        store.put(i.to_bytes(4, "little") + b"\x01" + b"\x00" * 15,
+                  b"from-child" + str(i).encode())
+    store.close(unlink=False)
+
+
+def test_multiprocess_shared_arena():
+    store = NativeStore.create("/rt_test_d", 4 * 1024 * 1024)
+    try:
+        ctx = mp.get_context("spawn")
+        p = ctx.Process(target=_child_process, args=("/rt_test_d", 10))
+        p.start()
+        p.join(timeout=30)
+        assert p.exitcode == 0
+        for i in range(10):
+            key = i.to_bytes(4, "little") + b"\x01" + b"\x00" * 15
+            view = store.get(key)
+            assert view is not None
+            assert bytes(view) == b"from-child" + str(i).encode()
+            store.release(key)
+    finally:
+        store.close()
+
+
+def test_zero_copy_create_seal():
+    store = NativeStore.create("/rt_test_e", 1024 * 1024)
+    try:
+        # put() path already covers copy; check stats accounting.
+        arr = np.arange(1024, dtype=np.float32)
+        store.put(_key(9), arr.tobytes())
+        view = store.get(_key(9))
+        out = np.frombuffer(view, dtype=np.float32)
+        np.testing.assert_array_equal(out, arr)
+        store.release(_key(9))
+        assert store.stats()["used_bytes"] >= arr.nbytes
+    finally:
+        store.close()
