@@ -1,0 +1,29 @@
+"""Observability: metrics, state API, events, timeline, dashboard.
+
+Reference analog: ``ray.util.metrics``, ``ray.experimental.state.api``,
+``src/ray/stats``, ``src/ray/util/event.h``, ``dashboard/``.
+"""
+
+from .dashboard import Dashboard, start_dashboard, stop_dashboard
+from .events import EventLog, Severity, emit, global_event_log
+from .metrics import Counter, Gauge, Histogram, core_metrics, registry
+from .state import (
+    cluster_status,
+    list_actors,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    list_workers,
+    record_span,
+    summarize_tasks,
+    timeline,
+)
+
+__all__ = [
+    "Counter", "Dashboard", "EventLog", "Gauge", "Histogram", "Severity",
+    "cluster_status", "core_metrics", "emit", "global_event_log",
+    "list_actors", "list_nodes", "list_objects", "list_placement_groups",
+    "list_tasks", "list_workers", "record_span", "registry",
+    "start_dashboard", "stop_dashboard", "summarize_tasks", "timeline",
+]

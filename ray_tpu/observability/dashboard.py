@@ -1,0 +1,105 @@
+"""Dashboard-lite: HTTP JSON endpoints over the state API + metrics.
+
+Reference analog: ``dashboard/head.py`` (aiohttp module host) +
+``dashboard/state_aggregator.py`` + ``modules/metrics`` — served here by a
+stdlib threading HTTP server:
+
+  GET /api/nodes /api/tasks /api/actors /api/objects /api/workers
+      /api/placement_groups /api/summary /api/events
+  GET /metrics          (Prometheus text)
+  GET /healthz          (reference: modules/healthz)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+from . import state as state_api
+from .events import global_event_log
+from .metrics import registry
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._server = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Dashboard":
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        routes = {
+            "/api/nodes": state_api.list_nodes,
+            "/api/tasks": state_api.list_tasks,
+            "/api/actors": state_api.list_actors,
+            "/api/objects": state_api.list_objects,
+            "/api/workers": state_api.list_workers,
+            "/api/placement_groups": state_api.list_placement_groups,
+            "/api/summary": state_api.summarize_tasks,
+            "/api/events": lambda: global_event_log().query(limit=200),
+        }
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    self.send_response(200)
+                    self.end_headers()
+                    self.wfile.write(b"success")
+                    return
+                if path == "/metrics":
+                    body = registry.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                fn = routes.get(path)
+                if fn is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    body = json.dumps(fn()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:  # noqa: BLE001
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(json.dumps({"error": str(e)}).encode())
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="rt-dashboard")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+
+_dashboard: Optional[Dashboard] = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> Dashboard:
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = Dashboard(host, port).start()
+    return _dashboard
+
+
+def stop_dashboard() -> None:
+    global _dashboard
+    if _dashboard is not None:
+        _dashboard.stop()
+        _dashboard = None

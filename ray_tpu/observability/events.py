@@ -1,0 +1,85 @@
+"""Structured event log.
+
+Reference analog: ``src/ray/util/event.h`` (structured JSON events with
+labels/severity) consumed by the dashboard event module. Events append to a
+bounded in-memory ring + optional JSONL file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+class Severity:
+    DEBUG = "DEBUG"
+    INFO = "INFO"
+    WARNING = "WARNING"
+    ERROR = "ERROR"
+    FATAL = "FATAL"
+
+
+class EventLog:
+    def __init__(self, max_events: int = 10_000,
+                 file_path: Optional[str] = None):
+        self._ring: deque = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+        self._file_path = file_path
+        self._file = None
+        if file_path:
+            os.makedirs(os.path.dirname(file_path), exist_ok=True)
+            self._file = open(file_path, "a", buffering=1)
+
+    def emit(self, label: str, message: str,
+             severity: str = Severity.INFO,
+             custom_fields: Optional[Dict[str, Any]] = None) -> Dict:
+        event = {
+            "timestamp": time.time(),
+            "severity": severity,
+            "label": label,
+            "message": message,
+            "custom_fields": custom_fields or {},
+            "pid": os.getpid(),
+        }
+        with self._lock:
+            self._ring.append(event)
+            if self._file:
+                self._file.write(json.dumps(event) + "\n")
+        return event
+
+    def query(self, label: Optional[str] = None,
+              severity: Optional[str] = None,
+              limit: int = 100) -> List[Dict]:
+        with self._lock:
+            events = list(self._ring)
+        if label:
+            events = [e for e in events if e["label"] == label]
+        if severity:
+            events = [e for e in events if e["severity"] == severity]
+        return events[-limit:]
+
+    def close(self):
+        if self._file:
+            self._file.close()
+            self._file = None
+
+
+_global_log: Optional[EventLog] = None
+_global_lock = threading.Lock()
+
+
+def global_event_log() -> EventLog:
+    global _global_log
+    with _global_lock:
+        if _global_log is None:
+            _global_log = EventLog()
+        return _global_log
+
+
+def emit(label: str, message: str, severity: str = Severity.INFO,
+         **custom_fields) -> None:
+    global_event_log().emit(label, message, severity, custom_fields)

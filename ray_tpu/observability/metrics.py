@@ -1,0 +1,167 @@
+"""Application + runtime metrics: Counter/Gauge/Histogram with a registry.
+
+Reference analog: ``python/ray/util/metrics.py`` (user-facing API) +
+``src/ray/stats/metric_defs.cc`` (runtime metric definitions) +
+``_private/metrics_agent.py`` (aggregation + Prometheus text export).
+Single-process registry here; the dashboard module serves the Prometheus
+text format over HTTP.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_TagKey = Tuple[Tuple[str, str], ...]
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._lock = threading.Lock()
+        registry.register(self)
+
+    def _tags_key(self, tags: Optional[Dict[str, str]]) -> _TagKey:
+        tags = tags or {}
+        return tuple(sorted(tags.items()))
+
+
+class Counter(Metric):
+    def __init__(self, name, description="", tag_keys=()):
+        self._values: Dict[_TagKey, float] = defaultdict(float)
+        super().__init__(name, description, tag_keys)
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[self._tags_key(tags)] += value
+
+    def collect(self):
+        with self._lock:
+            return ("counter", dict(self._values))
+
+
+class Gauge(Metric):
+    def __init__(self, name, description="", tag_keys=()):
+        self._values: Dict[_TagKey, float] = {}
+        super().__init__(name, description, tag_keys)
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[self._tags_key(tags)] = value
+
+    def collect(self):
+        with self._lock:
+            return ("gauge", dict(self._values))
+
+
+class Histogram(Metric):
+    def __init__(self, name, description="", boundaries: Sequence[float] = (),
+                 tag_keys=()):
+        self.boundaries = sorted(boundaries) or [
+            0.001, 0.01, 0.1, 1, 10, 100, 1000
+        ]
+        self._counts: Dict[_TagKey, List[int]] = {}
+        self._sums: Dict[_TagKey, float] = defaultdict(float)
+        self._totals: Dict[_TagKey, int] = defaultdict(int)
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._tags_key(tags)
+        with self._lock:
+            if key not in self._counts:
+                self._counts[key] = [0] * (len(self.boundaries) + 1)
+            idx = bisect.bisect_left(self.boundaries, value)
+            self._counts[key][idx] += 1
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    def collect(self):
+        with self._lock:
+            return ("histogram", {
+                k: {"buckets": list(v), "sum": self._sums[k],
+                    "count": self._totals[k]}
+                for k, v in self._counts.items()
+            })
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: Metric) -> None:
+        with self._lock:
+            self._metrics[metric.name] = metric
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def collect_all(self) -> Dict[str, tuple]:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: m.collect() for m in metrics}
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format (reference: prometheus_exporter.py)."""
+        lines = []
+        for name, (kind, data) in sorted(self.collect_all().items()):
+            safe = name.replace(".", "_").replace("-", "_")
+            lines.append(f"# TYPE {safe} "
+                         f"{'counter' if kind == 'counter' else 'gauge' if kind == 'gauge' else 'histogram'}")
+            if kind in ("counter", "gauge"):
+                for tags, value in data.items():
+                    label = ",".join(f'{k}="{v}"' for k, v in tags)
+                    label = "{" + label + "}" if label else ""
+                    lines.append(f"{safe}{label} {value}")
+            else:
+                for tags, h in data.items():
+                    base = ",".join(f'{k}="{v}"' for k, v in tags)
+                    metric = self._metrics.get(name)
+                    cumulative = 0
+                    for b, c in zip(metric.boundaries + [float("inf")],
+                                    h["buckets"]):
+                        cumulative += c
+                        le = f'le="{b}"'
+                        lbl = "{" + (base + "," if base else "") + le + "}"
+                        lines.append(f"{safe}_bucket{lbl} {cumulative}")
+                    lbl = "{" + base + "}" if base else ""
+                    lines.append(f"{safe}_sum{lbl} {h['sum']}")
+                    lines.append(f"{safe}_count{lbl} {h['count']}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+registry = MetricsRegistry()
+
+# -- core runtime metrics (reference: stats/metric_defs.cc subset) -----------
+
+_core_lock = threading.Lock()
+_core: Dict[str, Metric] = {}
+
+
+def core_metrics() -> Dict[str, Metric]:
+    with _core_lock:
+        if not _core:
+            _core["tasks_submitted"] = Counter(
+                "rt_tasks_submitted", "Tasks submitted", ("type",))
+            _core["tasks_finished"] = Counter(
+                "rt_tasks_finished", "Tasks finished", ("state",))
+            _core["task_latency_s"] = Histogram(
+                "rt_task_latency_seconds", "Task execution wall time")
+            _core["object_store_bytes"] = Gauge(
+                "rt_object_store_bytes", "Per-node store usage", ("node",))
+            _core["actors_alive"] = Gauge("rt_actors_alive", "Live actors")
+            _core["workers_alive"] = Gauge("rt_workers_alive", "Live workers")
+        return _core

@@ -1,0 +1,179 @@
+"""State API: list/inspect cluster entities.
+
+Reference analog: ``python/ray/experimental/state/api.py`` (list_tasks/
+list_actors/list_objects/list_nodes/summarize) + the dashboard
+``state_aggregator.py``. Queries run against the live head runtime.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _head():
+    from ..core.runtime import get_head_runtime
+
+    rt = get_head_runtime()
+    if rt is None:
+        raise RuntimeError("state API requires an initialized head runtime")
+    return rt
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    rt = _head()
+    out = []
+    for info in rt.gcs.nodes.values():
+        node = rt.scheduler.get_node(info.node_id)
+        out.append({
+            "node_id": info.node_id.hex(),
+            "alive": info.alive,
+            "resources_total": dict(info.resources),
+            "resources_available": (dict(node.ledger.available)
+                                    if node else {}),
+            "labels": dict(info.labels),
+            "topology": dict(info.topology),
+            "object_store": node.store.stats() if node else {},
+        })
+    return out
+
+
+def list_tasks(filters: Optional[Dict[str, str]] = None,
+               limit: int = 1000) -> List[Dict[str, Any]]:
+    rt = _head()
+    out = []
+    with rt._lock:
+        records = list(rt._tasks.values())
+    for rec in records[-limit:]:
+        row = {
+            "task_id": rec.spec.task_id.hex(),
+            "name": rec.spec.name or rec.spec.method_name or "",
+            "type": rec.spec.task_type.name,
+            "state": rec.state,
+            "resources": dict(rec.spec.resources),
+            "node_id": rec.node.node_id.hex() if rec.node else None,
+        }
+        if filters and any(str(row.get(k)) != str(v)
+                           for k, v in filters.items()):
+            continue
+        out.append(row)
+    return out
+
+
+def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:
+    rt = _head()
+    out = []
+    for info in rt.gcs.list_actors()[-limit:]:
+        out.append({
+            "actor_id": info.actor_id.hex(),
+            "name": info.name,
+            "state": info.state,
+            "node_id": info.node_id.hex() if info.node_id else None,
+            "num_restarts": info.num_restarts,
+            "death_cause": info.death_cause,
+        })
+    return out
+
+
+def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
+    rt = _head()
+    out = []
+    with rt._lock:
+        items = list(rt._objects.items())
+    for oid, entry in items[-limit:]:
+        loc = entry.location
+        out.append({
+            "object_id": oid.hex(),
+            "status": entry.status,
+            "location": (loc[0] if loc else None),
+            "node_id": (loc[1].hex() if loc and loc[0] == "shm" else None),
+            "size": (loc[2] if loc and loc[0] == "shm" else None),
+            "refcount": rt._refcounts.get(oid, 0),
+        })
+    return out
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    rt = _head()
+    return [
+        {
+            "pg_id": pg.id.hex(),
+            "name": pg.name,
+            "state": pg.state,
+            "strategy": pg.strategy,
+            "bundles": pg.bundles,
+        }
+        for pg in rt.gcs.placement_groups.values()
+    ]
+
+
+def list_workers() -> List[Dict[str, Any]]:
+    rt = _head()
+    out = []
+    for node in rt.scheduler.nodes():
+        for w in node.pool.all_workers():
+            out.append({
+                "worker_id": w.worker_id.hex(),
+                "node_id": node.node_id.hex(),
+                "state": w.state,
+                "pid": w.process.pid,
+                "alive": w.alive(),
+            })
+    return out
+
+
+def summarize_tasks() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for row in list_tasks():
+        counts[row["state"]] = counts.get(row["state"], 0) + 1
+    return counts
+
+
+def cluster_status() -> str:
+    """Human-readable summary (reference: `ray status` output shape)."""
+    rt = _head()
+    lines = ["======== Cluster status ========"]
+    total = rt.cluster_resources()
+    avail = rt.available_resources()
+    lines.append("Resources")
+    for k in sorted(total):
+        lines.append(f"  {total.get(k, 0) - avail.get(k, 0):.1f}/"
+                     f"{total[k]:.1f} {k}")
+    nodes = list_nodes()
+    lines.append(f"Nodes: {sum(1 for n in nodes if n['alive'])} alive, "
+                 f"{sum(1 for n in nodes if not n['alive'])} dead")
+    tasks = summarize_tasks()
+    if tasks:
+        lines.append("Tasks: " + ", ".join(
+            f"{v} {k}" for k, v in sorted(tasks.items())))
+    actors = list_actors()
+    alive = sum(1 for a in actors if a["state"] == "ALIVE")
+    lines.append(f"Actors: {alive} alive / {len(actors)} total")
+    return "\n".join(lines)
+
+
+# -- timeline (reference: ray.timeline -> chrome://tracing JSON) -------------
+
+_events: List[Dict[str, Any]] = []
+_events_lock = None
+
+
+def record_span(name: str, category: str, start_s: float, end_s: float,
+                pid: int = 0, tid: int = 0, args: Optional[dict] = None):
+    _events.append({
+        "name": name, "cat": category, "ph": "X",
+        "ts": start_s * 1e6, "dur": (end_s - start_s) * 1e6,
+        "pid": pid, "tid": tid, "args": args or {},
+    })
+
+
+def timeline(filename: Optional[str] = None):
+    """Dump chrome://tracing events (reference: _private/state.py:828)."""
+    import json
+
+    data = list(_events)
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(data, f)
+        return filename
+    return data

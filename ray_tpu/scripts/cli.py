@@ -1,0 +1,130 @@
+"""``rt`` command-line interface.
+
+Reference analog: ``python/ray/scripts/scripts.py`` (the click-based ``ray``
+CLI: start/stop/status/memory/timeline/microbenchmark + state listing via
+``ray list``). Subcommands here operate on an in-process runtime (the
+single-host deployment mode); multi-host attach arrives with the socket
+control plane.
+
+Usage: python -m ray_tpu.scripts.cli <command> [...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def cmd_status(args) -> int:
+    import ray_tpu as rt
+    from ray_tpu.observability import cluster_status
+
+    rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
+    print(cluster_status())
+    return 0
+
+
+def cmd_list(args) -> int:
+    import ray_tpu as rt
+    from ray_tpu import observability as obs
+
+    rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
+    fns = {
+        "nodes": obs.list_nodes,
+        "tasks": obs.list_tasks,
+        "actors": obs.list_actors,
+        "objects": obs.list_objects,
+        "workers": obs.list_workers,
+        "placement-groups": obs.list_placement_groups,
+    }
+    rows = fns[args.entity]()
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def cmd_memory(args) -> int:
+    import ray_tpu as rt
+    from ray_tpu.observability import list_nodes, list_objects
+
+    rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
+    for node in list_nodes():
+        store = node.get("object_store", {})
+        print(f"node {node['node_id'][:12]}: "
+              f"{store.get('used_bytes', 0)}/{store.get('capacity_bytes', 0)}"
+              f" bytes, {store.get('num_objects', 0)} objects, "
+              f"{store.get('num_spilled', 0)} spilled")
+    objs = list_objects()
+    print(f"{len(objs)} tracked objects")
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    import ray_tpu as rt
+    from ray_tpu.observability import timeline
+
+    rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
+    path = timeline(args.output)
+    print(f"timeline written to {path}")
+    return 0
+
+
+def cmd_microbenchmark(args) -> int:
+    from ray_tpu.scripts.microbenchmark import main as bench_main
+
+    for row in bench_main(duration=args.duration):
+        print(json.dumps(row))
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    import time
+
+    import ray_tpu as rt
+    from ray_tpu.observability import start_dashboard
+
+    rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
+    start_dashboard(port=args.port)
+    print(f"dashboard on http://127.0.0.1:{args.port} "
+          f"(/api/nodes, /api/tasks, /metrics, /healthz); Ctrl-C to stop")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="rt", description=__doc__)
+    p.add_argument("--num-cpus", type=float, default=None)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("status", help="cluster resource/task/actor summary")
+    lp = sub.add_parser("list", help="list cluster entities")
+    lp.add_argument("entity", choices=["nodes", "tasks", "actors", "objects",
+                                       "workers", "placement-groups"])
+    sub.add_parser("memory", help="object store usage")
+    tp = sub.add_parser("timeline", help="dump chrome://tracing json")
+    tp.add_argument("--output", default="/tmp/rt_timeline.json")
+    mb = sub.add_parser("microbenchmark", help="core perf scenarios")
+    mb.add_argument("--duration", type=float, default=2.0)
+    dp = sub.add_parser("dashboard", help="serve the state/metrics HTTP API")
+    dp.add_argument("--port", type=int, default=8265)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    handlers = {
+        "status": cmd_status,
+        "list": cmd_list,
+        "memory": cmd_memory,
+        "timeline": cmd_timeline,
+        "microbenchmark": cmd_microbenchmark,
+        "dashboard": cmd_dashboard,
+    }
+    return handlers[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

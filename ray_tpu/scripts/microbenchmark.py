@@ -1,0 +1,109 @@
+"""Core microbenchmarks.
+
+Reference analog: ``python/ray/_private/ray_perf.py:93-274`` (the `ray
+microbenchmark` scenario suite: tasks/s sync+async, 1:1/1:n/n:n actor
+calls/s, put throughput) — same scenario shapes, measured against this
+runtime.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+
+def timeit(name: str, fn: Callable, multiplier: int = 1,
+           duration: float = 2.0) -> Dict:
+    """Run fn repeatedly for ~duration, report ops/s (reference: timeit)."""
+    # warmup
+    fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < duration:
+        fn()
+        count += 1
+    elapsed = time.perf_counter() - start
+    rate = count * multiplier / elapsed
+    return {"name": name, "ops_per_s": round(rate, 1)}
+
+
+def main(duration: float = 2.0) -> List[Dict]:
+    import ray_tpu as rt
+
+    rt.init(ignore_reinit_error=True)
+    results = []
+
+    @rt.remote
+    def noop():
+        return None
+
+    @rt.remote
+    def noop_small(x):
+        return x
+
+    # single client sync task throughput
+    results.append(timeit(
+        "single client tasks sync", lambda: rt.get(noop.remote()),
+        duration=duration))
+
+    # async batch submission
+    def async_batch():
+        rt.get([noop.remote() for _ in range(100)])
+
+    results.append(timeit("single client tasks async (batch 100)",
+                          async_batch, multiplier=100, duration=duration))
+
+    # put throughput: small objects
+    results.append(timeit("put small (1KB)", lambda: rt.put(b"x" * 1024),
+                          duration=duration))
+
+    # put throughput: large objects GB/s
+    big = np.zeros(10 * 1024 * 1024 // 8, dtype=np.float64)  # 10MB
+
+    def put_big():
+        rt.put(big)
+
+    r = timeit("put large (10MB)", put_big, duration=duration)
+    r["GB_per_s"] = round(r["ops_per_s"] * 10 / 1024, 3)
+    results.append(r)
+
+    # get throughput: large object
+    ref = rt.put(big)
+    r = timeit("get large (10MB)", lambda: rt.get(ref), duration=duration)
+    r["GB_per_s"] = round(r["ops_per_s"] * 10 / 1024, 3)
+    results.append(r)
+
+    @rt.remote
+    class Actor:
+        def method(self, x=None):
+            return x
+
+    a = Actor.remote()
+    rt.get(a.method.remote())
+    results.append(timeit("1:1 actor calls sync",
+                          lambda: rt.get(a.method.remote()),
+                          duration=duration))
+
+    def actor_async():
+        rt.get([a.method.remote() for _ in range(100)])
+
+    results.append(timeit("1:1 actor calls async (batch 100)", actor_async,
+                          multiplier=100, duration=duration))
+
+    # n:n — 4 actors, 4 batches in flight
+    actors = [Actor.remote() for _ in range(4)]
+    rt.get([x.method.remote() for x in actors])
+
+    def nn_calls():
+        rt.get([x.method.remote(i) for x in actors for i in range(25)])
+
+    results.append(timeit("4:4 actor calls async (batch 100)", nn_calls,
+                          multiplier=100, duration=duration))
+    return results
+
+
+if __name__ == "__main__":
+    for row in main():
+        print(row)

@@ -1,0 +1,110 @@
+"""Observability tests: metrics, state API, events, dashboard HTTP."""
+
+import json
+import urllib.request
+
+import pytest
+
+
+def test_counter_gauge_histogram():
+    from ray_tpu.observability import Counter, Gauge, Histogram, registry
+
+    c = Counter("t_requests", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    g = Gauge("t_depth")
+    g.set(7)
+    h = Histogram("t_latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    collected = registry.collect_all()
+    assert collected["t_requests"][1][(("route", "/a"),)] == 3
+    assert collected["t_depth"][1][()] == 7
+    hist = collected["t_latency"][1][()]
+    assert hist["count"] == 3
+    assert hist["buckets"] == [1, 1, 1]
+
+    text = registry.prometheus_text()
+    assert 't_requests{route="/a"} 3' in text
+    assert "t_latency_bucket" in text
+
+
+def test_state_api(rt_shared):
+    import ray_tpu as rt
+    from ray_tpu.observability import (
+        cluster_status,
+        list_actors,
+        list_nodes,
+        list_tasks,
+        list_workers,
+        summarize_tasks,
+    )
+
+    @rt.remote
+    def f():
+        return 1
+
+    rt.get([f.remote() for _ in range(3)])
+
+    @rt.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    rt.get(a.ping.remote())
+
+    nodes = list_nodes()
+    assert nodes and nodes[0]["alive"]
+    tasks = list_tasks()
+    assert any(t["name"] == "f" for t in tasks)
+    actors = list_actors()
+    assert any(x["state"] == "ALIVE" for x in actors)
+    workers = list_workers()
+    assert any(w["state"] == "DEDICATED" for w in workers)
+    assert summarize_tasks().get("DONE", 0) >= 3
+    status = cluster_status()
+    assert "Cluster status" in status and "CPU" in status
+
+
+def test_events():
+    from ray_tpu.observability import Severity, emit, global_event_log
+
+    emit("test_label", "something happened", Severity.WARNING, detail=42)
+    events = global_event_log().query(label="test_label")
+    assert events
+    assert events[-1]["severity"] == "WARNING"
+    assert events[-1]["custom_fields"]["detail"] == 42
+
+
+def test_dashboard_http(rt_shared):
+    from ray_tpu.observability import start_dashboard, stop_dashboard
+
+    start_dashboard(port=18266)
+    try:
+        with urllib.request.urlopen(
+            "http://127.0.0.1:18266/healthz", timeout=10
+        ) as r:
+            assert r.read() == b"success"
+        with urllib.request.urlopen(
+            "http://127.0.0.1:18266/api/nodes", timeout=10
+        ) as r:
+            nodes = json.loads(r.read())
+        assert nodes and "resources_total" in nodes[0]
+        with urllib.request.urlopen(
+            "http://127.0.0.1:18266/metrics", timeout=10
+        ) as r:
+            assert b"TYPE" in r.read()
+    finally:
+        stop_dashboard()
+
+
+def test_timeline_spans(tmp_path):
+    from ray_tpu.observability import record_span, timeline
+
+    record_span("task:f", "task", 1.0, 1.5, pid=1, tid=2)
+    path = timeline(str(tmp_path / "tl.json"))
+    data = json.load(open(path))
+    assert any(e["name"] == "task:f" and e["dur"] == 500000.0 for e in data)
