@@ -136,6 +136,21 @@ class Runtime:
             config().heartbeat_period_ms / 1000.0,
             config().num_heartbeats_timeout,
         )
+        # Heartbeat loop for in-process node managers (reference: each
+        # raylet reports to GcsHeartbeatManager; here one thread beats for
+        # every node still registered with the scheduler).
+        self._hb_stop = threading.Event()
+
+        def _heartbeats():
+            period = config().heartbeat_period_ms / 1000.0
+            while not self._hb_stop.wait(period):
+                for node in self.scheduler.nodes():
+                    if node.alive:
+                        self.gcs.heartbeat(node.node_id)
+
+        self._hb_thread = threading.Thread(target=_heartbeats, daemon=True,
+                                           name="rt-heartbeats")
+        self._hb_thread.start()
         install_refcount_hooks(
             add=self._ref_added, remove=self._ref_removed, borrow=self._ref_added
         )
@@ -1062,6 +1077,7 @@ class Runtime:
     def shutdown(self) -> None:
         self.gcs.finish_job(self.job_id)
         install_refcount_hooks()
+        self._hb_stop.set()
         self.scheduler.shutdown()
         self.gcs.shutdown()
 
