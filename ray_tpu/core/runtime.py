@@ -89,6 +89,7 @@ class _ActorRecord:
     methods: Dict[str, dict] = field(default_factory=dict)
     creation_pins_released: bool = False
     resources_released: bool = False
+    termination_requested: bool = False
 
 
 class Runtime:
@@ -628,6 +629,10 @@ class Runtime:
                               worker_id=record.worker.worker_id)
         for spec in pending:
             self._push_actor_task(record, spec)
+        if record.termination_requested:
+            # Deferred handle-GC termination: the queued methods above are
+            # already in the worker's pipe, so drain_exit runs after them.
+            self.terminate_actor(record.actor_id)
 
     def _actor_creation_failed(self, record: _ActorRecord, error: Exception) -> None:
         with self._lock:
@@ -722,6 +727,11 @@ class Runtime:
         with self._lock:
             record = self._actors.get(actor_id)
             if record is None or record.state == ActorState.DEAD:
+                return
+            if record.state in (ActorState.PENDING, ActorState.RESTARTING):
+                # Creation in flight: queued method calls must run first.
+                # Termination resumes once the actor is ALIVE and drained.
+                record.termination_requested = True
                 return
             record.state = ActorState.DEAD
             record.restarts_left = 0

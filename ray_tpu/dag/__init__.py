@@ -1,0 +1,183 @@
+"""Lazy task/actor call graphs.
+
+Reference analog: ``python/ray/dag/`` — ``DAGNode`` base with
+``FunctionNode``/``ClassNode``/``ClassMethodNode``/``InputNode``;
+``.bind(...)`` builds the graph, ``.execute(...)`` walks it submitting
+tasks/actor calls. Used by Serve deployment graphs and Workflow.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class DAGNode:
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+        self._uuid = uuid.uuid4().hex
+
+    # -- graph walking -------------------------------------------------------
+    def _children(self) -> List["DAGNode"]:
+        out = []
+        for a in list(self._bound_args) + list(self._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                out.append(a)
+        return out
+
+    def topological(self) -> List["DAGNode"]:
+        seen: Dict[str, DAGNode] = {}
+        order: List[DAGNode] = []
+
+        def visit(node: DAGNode):
+            if node._uuid in seen:
+                return
+            seen[node._uuid] = node
+            for child in node._children():
+                visit(child)
+            order.append(node)
+
+        visit(self)
+        return order
+
+    def _resolve_args(self, resolved: Dict[str, Any], input_value):
+        def sub(x):
+            if isinstance(x, InputNode):
+                return input_value
+            if isinstance(x, InputAttributeNode):
+                return x.extract(input_value)
+            if isinstance(x, DAGNode):
+                return resolved[x._uuid]
+            return x
+
+        args = tuple(sub(a) for a in self._bound_args)
+        kwargs = {k: sub(v) for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def execute(self, input_value: Any = None):
+        """Submit the graph; returns the root's ObjectRef (or value)."""
+        from ..core import get
+
+        resolved: Dict[str, Any] = {}
+        for node in self.topological():
+            if isinstance(node, (InputNode, InputAttributeNode)):
+                continue
+            resolved[node._uuid] = node._execute_one(resolved, input_value)
+        return resolved[self._uuid]
+
+    def _execute_one(self, resolved, input_value):
+        raise NotImplementedError
+
+
+class InputNode(DAGNode):
+    """Placeholder for the runtime input (reference: dag/input_node.py)."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return InputAttributeNode(self, item)
+
+    def __getitem__(self, key):
+        return InputAttributeNode(self, key, is_item=True)
+
+
+class InputAttributeNode(DAGNode):
+    def __init__(self, parent: InputNode, key, is_item: bool = False):
+        super().__init__((), {})
+        self._key = key
+        self._is_item = is_item
+
+    def extract(self, input_value):
+        if self._is_item:
+            return input_value[self._key]
+        return getattr(input_value, self._key)
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._fn = remote_fn
+
+    def _execute_one(self, resolved, input_value):
+        args, kwargs = self._resolve_args(resolved, input_value)
+        return self._fn.remote(*args, **kwargs)
+
+    def __repr__(self):
+        return f"FunctionNode({getattr(self._fn, '__name__', 'fn')})"
+
+
+class ClassNode(DAGNode):
+    """Actor instantiation node; method calls on it yield ClassMethodNodes."""
+
+    def __init__(self, actor_cls, args, kwargs):
+        super().__init__(args, kwargs)
+        self._cls = actor_cls
+
+    def _execute_one(self, resolved, input_value):
+        args, kwargs = self._resolve_args(resolved, input_value)
+        return self._cls.remote(*args, **kwargs)
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return _MethodBinder(self, item)
+
+
+class _MethodBinder:
+    def __init__(self, class_node: ClassNode, method: str):
+        self._node = class_node
+        self._method = method
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._node, self._method, args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method: str, args, kwargs):
+        super().__init__(args, kwargs)
+        self._class_node = class_node
+        self._method = method
+
+    def _children(self):
+        return super()._children() + [self._class_node]
+
+    def _execute_one(self, resolved, input_value):
+        handle = resolved[self._class_node._uuid]
+        args, kwargs = self._resolve_args(resolved, input_value)
+        return getattr(handle, self._method).remote(*args, **kwargs)
+
+
+def bind_function(remote_fn, *args, **kwargs) -> FunctionNode:
+    return FunctionNode(remote_fn, args, kwargs)
+
+
+def bind_class(actor_cls, *args, **kwargs) -> ClassNode:
+    return ClassNode(actor_cls, args, kwargs)
+
+
+def _install_bind_methods() -> None:
+    """Give RemoteFunction/ActorClass a ``.bind`` (reference API shape)."""
+    from ..core.actor import ActorClass
+    from ..core.remote_function import RemoteFunction
+
+    def fn_bind(self, *args, **kwargs):
+        return FunctionNode(self, args, kwargs)
+
+    def cls_bind(self, *args, **kwargs):
+        return ClassNode(self, args, kwargs)
+
+    RemoteFunction.bind = fn_bind
+    ActorClass.bind = cls_bind
+
+
+_install_bind_methods()

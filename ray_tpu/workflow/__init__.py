@@ -1,0 +1,199 @@
+"""Workflow: durable DAG execution with resume.
+
+Reference analog: ``python/ray/workflow`` — ``workflow.run/run_async/
+resume/resume_all/get_output/get_status`` (api.py:120-533); every DAG node
+result persists to storage (``workflow_storage.py``) so a crashed or
+interrupted workflow resumes from completed steps; the state machine of
+``workflow_executor.py:32,72`` walks pending steps whose deps are done.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from ..core import get
+from ..dag import DAGNode, InputAttributeNode, InputNode
+
+
+class WorkflowStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+    RESUMABLE = "RESUMABLE"
+
+
+_DEFAULT_STORAGE = os.path.join(
+    os.environ.get("TMPDIR", "/tmp"), "rt_workflows"
+)
+_storage_root = [_DEFAULT_STORAGE]
+
+
+def init(storage: Optional[str] = None) -> None:
+    """Set the workflow storage root (reference: ray.init(storage=...))."""
+    if storage:
+        _storage_root[0] = storage
+
+
+class WorkflowStorage:
+    """Per-workflow step-result persistence (workflow_storage.py)."""
+
+    def __init__(self, workflow_id: str):
+        self.workflow_id = workflow_id
+        self.dir = os.path.join(_storage_root[0], workflow_id)
+        os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
+
+    def save_step(self, step_id: str, value: Any) -> None:
+        path = os.path.join(self.dir, "steps", f"{step_id}.pkl")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, path)  # atomic commit
+
+    def load_step(self, step_id: str):
+        path = os.path.join(self.dir, "steps", f"{step_id}.pkl")
+        if not os.path.exists(path):
+            return None, False
+        with open(path, "rb") as f:
+            return pickle.load(f), True
+
+    def save_meta(self, meta: Dict) -> None:
+        with open(os.path.join(self.dir, "meta.json"), "w") as f:
+            json.dump(meta, f)
+
+    def load_meta(self) -> Optional[Dict]:
+        path = os.path.join(self.dir, "meta.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    def save_dag(self, dag: DAGNode, input_value: Any) -> None:
+        from ..core import serialization
+
+        with open(os.path.join(self.dir, "dag.pkl"), "wb") as f:
+            f.write(serialization.dumps((dag, input_value)))
+
+    def load_dag(self):
+        path = os.path.join(self.dir, "dag.pkl")
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return pickle.loads(f.read())
+
+
+def _step_key(node: DAGNode, index: int) -> str:
+    # Stable step identity: topological position + node type/name.
+    return f"{index:04d}_{type(node).__name__}"
+
+
+def _execute_workflow(workflow_id: str, dag: DAGNode, input_value: Any):
+    """Walk the DAG, skipping steps whose results are already persisted.
+
+    Reference: WorkflowExecutor.run_until_complete (workflow_executor.py:72).
+    """
+    storage = WorkflowStorage(workflow_id)
+    storage.save_meta({"status": WorkflowStatus.RUNNING,
+                       "start": time.time()})
+    resolved: Dict[str, Any] = {}
+    order = dag.topological()
+    try:
+        for i, node in enumerate(order):
+            if isinstance(node, (InputNode, InputAttributeNode)):
+                continue
+            key = _step_key(node, i)
+            cached, hit = storage.load_step(key)
+            if hit:
+                resolved[node._uuid] = cached
+                continue
+            ref_or_val = node._execute_one(resolved, input_value)
+            value = get(ref_or_val) if hasattr(ref_or_val, "id") else ref_or_val
+            storage.save_step(key, value)
+            resolved[node._uuid] = value
+        result = resolved[dag._uuid]
+        storage.save_meta({"status": WorkflowStatus.SUCCESSFUL,
+                           "end": time.time()})
+        storage.save_step("__output__", result)
+        return result
+    except Exception as e:  # noqa: BLE001
+        storage.save_meta({"status": WorkflowStatus.FAILED, "error": str(e)})
+        raise
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        workflow_input: Any = None):
+    """Run to completion, persisting each step (api.py:120)."""
+    import uuid as _uuid
+
+    workflow_id = workflow_id or f"wf-{_uuid.uuid4().hex[:8]}"
+    storage = WorkflowStorage(workflow_id)
+    storage.save_dag(dag, workflow_input)
+    return _execute_workflow(workflow_id, dag, workflow_input)
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              workflow_input: Any = None):
+    """Submit as a background task; returns an ObjectRef of the result."""
+    import uuid as _uuid
+
+    from ..core import remote
+
+    workflow_id = workflow_id or f"wf-{_uuid.uuid4().hex[:8]}"
+    WorkflowStorage(workflow_id).save_dag(dag, workflow_input)
+    runner = remote(_execute_workflow)
+    return runner.remote(workflow_id, dag, workflow_input)
+
+
+def resume(workflow_id: str):
+    """Re-run from persisted steps (api.py resume)."""
+    storage = WorkflowStorage(workflow_id)
+    loaded = storage.load_dag()
+    if loaded is None:
+        raise ValueError(f"unknown workflow {workflow_id!r}")
+    dag, input_value = loaded
+    return _execute_workflow(workflow_id, dag, input_value)
+
+
+def resume_all() -> List[str]:
+    out = []
+    root = _storage_root[0]
+    if not os.path.isdir(root):
+        return out
+    for wid in os.listdir(root):
+        meta = WorkflowStorage(wid).load_meta()
+        if meta and meta.get("status") in (WorkflowStatus.RUNNING,
+                                           WorkflowStatus.FAILED,
+                                           WorkflowStatus.RESUMABLE):
+            resume(wid)
+            out.append(wid)
+    return out
+
+
+def get_status(workflow_id: str) -> str:
+    meta = WorkflowStorage(workflow_id).load_meta()
+    if meta is None:
+        raise ValueError(f"unknown workflow {workflow_id!r}")
+    return meta["status"]
+
+
+def get_output(workflow_id: str):
+    value, hit = WorkflowStorage(workflow_id).load_step("__output__")
+    if not hit:
+        raise ValueError(f"workflow {workflow_id!r} has no output yet")
+    return value
+
+
+def list_all() -> List[Dict]:
+    root = _storage_root[0]
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for wid in sorted(os.listdir(root)):
+        meta = WorkflowStorage(wid).load_meta() or {}
+        out.append({"workflow_id": wid, "status": meta.get("status")})
+    return out

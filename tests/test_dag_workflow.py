@@ -1,0 +1,145 @@
+"""DAG + Workflow tests (mirrors python/ray/dag and ray/workflow tests)."""
+
+import os
+
+import pytest
+
+
+def test_function_dag(rt_shared):
+    import ray_tpu as rt
+    from ray_tpu.dag import InputNode
+
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    @rt.remote
+    def double(x):
+        return 2 * x
+
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), 5)
+
+    assert rt.get(dag.execute(10)) == 25
+
+
+def test_class_dag(rt_shared):
+    import ray_tpu as rt
+    from ray_tpu.dag import InputNode
+
+    @rt.remote
+    class Adder:
+        def __init__(self, base):
+            self.base = base
+
+        def add(self, x):
+            return self.base + x
+
+    with InputNode() as inp:
+        node = Adder.bind(100)
+        dag = node.add.bind(inp)
+
+    assert rt.get(dag.execute(7)) == 107
+
+
+def test_diamond_dag(rt_shared):
+    import ray_tpu as rt
+    from ray_tpu.dag import InputNode
+
+    @rt.remote
+    def left(x):
+        return x + 1
+
+    @rt.remote
+    def right(x):
+        return x * 2
+
+    @rt.remote
+    def join(a, b):
+        return (a, b)
+
+    with InputNode() as inp:
+        dag = join.bind(left.bind(inp), right.bind(inp))
+
+    assert rt.get(dag.execute(10)) == (11, 20)
+
+
+def test_workflow_run_and_output(rt_shared, tmp_path):
+    import ray_tpu as rt
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    workflow.init(str(tmp_path))
+
+    @rt.remote
+    def step_a(x):
+        return x + 1
+
+    @rt.remote
+    def step_b(x):
+        return x * 10
+
+    with InputNode() as inp:
+        dag = step_b.bind(step_a.bind(inp))
+
+    result = workflow.run(dag, workflow_id="wf-test", workflow_input=4)
+    assert result == 50
+    assert workflow.get_status("wf-test") == "SUCCESSFUL"
+    assert workflow.get_output("wf-test") == 50
+
+
+def test_workflow_resume_skips_completed_steps(rt_shared, tmp_path):
+    import ray_tpu as rt
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    workflow.init(str(tmp_path))
+    marker = str(tmp_path / "fail_once")
+
+    @rt.remote
+    def expensive(x):
+        # Count executions via a side file.
+        count_file = str(tmp_path) + "/exec_count"
+        n = int(open(count_file).read()) if os.path.exists(count_file) else 0
+        open(count_file, "w").write(str(n + 1))
+        return x * 2
+
+    @rt.remote
+    def flaky(x):
+        if not os.path.exists(marker):
+            open(marker, "w").write("1")
+            raise RuntimeError("transient failure")
+        return x + 1
+
+    with InputNode() as inp:
+        dag = flaky.bind(expensive.bind(inp))
+
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf-resume", workflow_input=3)
+    assert workflow.get_status("wf-resume") == "FAILED"
+
+    result = workflow.resume("wf-resume")
+    assert result == 7
+    # expensive ran only ONCE: the resume used its persisted result.
+    assert open(str(tmp_path) + "/exec_count").read() == "1"
+    assert workflow.get_status("wf-resume") == "SUCCESSFUL"
+
+
+def test_workflow_list(rt_shared, tmp_path):
+    import ray_tpu as rt
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    workflow.init(str(tmp_path))
+
+    @rt.remote
+    def f(x):
+        return x
+
+    with InputNode() as inp:
+        dag = f.bind(inp)
+
+    workflow.run(dag, workflow_id="wf-1", workflow_input=1)
+    rows = workflow.list_all()
+    assert any(r["workflow_id"] == "wf-1" and r["status"] == "SUCCESSFUL"
+               for r in rows)
