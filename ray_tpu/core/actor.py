@@ -41,6 +41,7 @@ _DEFAULT_ACTOR_OPTIONS = dict(
     lifetime=None,
     scheduling_strategy=None,
     num_returns=1,
+    runtime_env=None,
 )
 
 
@@ -205,6 +206,7 @@ class ActorClass:
             max_restarts=opts["max_restarts"],
             max_concurrency=opts["max_concurrency"],
             name=opts["name"] or "",
+            runtime_env=dict(opts["runtime_env"]) if opts.get("runtime_env") else None,
         )
         rt.submit_spec(spec)
         handle = ActorHandle(

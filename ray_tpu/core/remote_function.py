@@ -26,6 +26,7 @@ _DEFAULT_OPTIONS = dict(
     retry_exceptions=False,
     scheduling_strategy=None,
     name="",
+    runtime_env=None,
 )
 
 
@@ -124,6 +125,7 @@ class RemoteFunction:
             max_retries=opts["max_retries"],
             retry_exceptions=opts["retry_exceptions"],
             name=opts["name"] or self._fn.__name__,
+            runtime_env=dict(opts["runtime_env"]) if opts.get("runtime_env") else None,
         )
         refs = rt.submit_spec(spec)
         if opts["num_returns"] == 1:

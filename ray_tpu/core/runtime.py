@@ -370,7 +370,7 @@ class Runtime:
             if deadline is not None and time.monotonic() >= deadline:
                 break
             time.sleep(0.002)
-        ready = [r for r in refs if r.id in done][: max(num_returns, len(done))]
+        ready = [r for r in refs if r.id in done][:num_returns]
         ready_ids = {r.id for r in ready}
         not_ready = [r for r in refs if r.id not in ready_ids]
         return ready, not_ready
@@ -472,6 +472,7 @@ class Runtime:
             "num_returns": spec.num_returns,
             "max_concurrency": spec.max_concurrency,
             "name": spec.describe(),
+            "runtime_env": spec.runtime_env,
         }))
         if not ok:
             self._handle_worker_death(worker)

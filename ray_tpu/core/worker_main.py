@@ -242,7 +242,12 @@ class WorkerRuntime:
         task_type = TaskType(payload["task_type"])
         prev_task = self.current_task_id
         self.current_task_id = TaskID.from_hex(task_id_hex)
+        env_undo = None
         try:
+            if payload.get("runtime_env"):
+                from ..runtime_env import apply_runtime_env
+
+                env_undo = apply_runtime_env(payload["runtime_env"])
             resolved = {
                 i: self._materialize(entry)
                 for i, entry in payload.get("resolved_args", {}).items()
@@ -284,6 +289,10 @@ class WorkerRuntime:
             self._send(("error", task_id_hex, serialization.dumps(err),
                         isinstance(e, Exception)))
         finally:
+            if env_undo:
+                from ..runtime_env import restore_runtime_env
+
+                restore_runtime_env(env_undo)
             self.current_task_id = prev_task
 
     def run_task_loop(self) -> None:

@@ -1,0 +1,252 @@
+"""Job submission: HTTP REST API + client.
+
+Reference analog: ``dashboard/modules/job/`` (job manager running driver
+scripts as supervised subprocesses) + ``job/sdk.py:34,83``
+(``JobSubmissionClient.submit_job``). Jobs run as subprocesses whose
+stdout/stderr are captured; status/log endpoints mirror the REST schema.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+@dataclass
+class JobDetails:
+    submission_id: str
+    entrypoint: str
+    status: str = JobStatus.PENDING
+    start_time: Optional[float] = None
+    end_time: Optional[float] = None
+    metadata: Dict[str, str] = field(default_factory=dict)
+    log_path: str = ""
+    returncode: Optional[int] = None
+
+
+class JobManager:
+    """Supervises driver subprocesses (reference: job supervisor actor)."""
+
+    def __init__(self, log_dir: Optional[str] = None):
+        self.log_dir = log_dir or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "rt_jobs"
+        )
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._jobs: Dict[str, JobDetails] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def submit(self, entrypoint: str,
+               submission_id: Optional[str] = None,
+               runtime_env: Optional[dict] = None,
+               metadata: Optional[Dict[str, str]] = None) -> str:
+        submission_id = submission_id or f"job-{uuid.uuid4().hex[:10]}"
+        log_path = os.path.join(self.log_dir, f"{submission_id}.log")
+        details = JobDetails(submission_id, entrypoint,
+                             metadata=metadata or {}, log_path=log_path)
+        env = dict(os.environ)
+        if runtime_env and runtime_env.get("env_vars"):
+            env.update(runtime_env["env_vars"])
+        cwd = (runtime_env or {}).get("working_dir") or os.getcwd()
+        log_f = open(log_path, "wb")
+        proc = subprocess.Popen(
+            entrypoint, shell=True, stdout=log_f, stderr=subprocess.STDOUT,
+            env=env, cwd=cwd,
+        )
+        details.status = JobStatus.RUNNING
+        details.start_time = time.time()
+        with self._lock:
+            self._jobs[submission_id] = details
+            self._procs[submission_id] = proc
+
+        def reap():
+            rc = proc.wait()
+            log_f.close()
+            with self._lock:
+                details.end_time = time.time()
+                details.returncode = rc
+                if details.status != JobStatus.STOPPED:
+                    details.status = (JobStatus.SUCCEEDED if rc == 0
+                                      else JobStatus.FAILED)
+
+        threading.Thread(target=reap, daemon=True).start()
+        return submission_id
+
+    def status(self, submission_id: str) -> str:
+        with self._lock:
+            d = self._jobs.get(submission_id)
+        if d is None:
+            raise KeyError(f"unknown job {submission_id!r}")
+        return d.status
+
+    def details(self, submission_id: str) -> JobDetails:
+        with self._lock:
+            d = self._jobs.get(submission_id)
+        if d is None:
+            raise KeyError(f"unknown job {submission_id!r}")
+        return d
+
+    def logs(self, submission_id: str) -> str:
+        d = self.details(submission_id)
+        if os.path.exists(d.log_path):
+            with open(d.log_path, "rb") as f:
+                return f.read().decode("utf-8", "replace")
+        return ""
+
+    def stop(self, submission_id: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(submission_id)
+            d = self._jobs.get(submission_id)
+        if proc is None or d is None:
+            return False
+        if proc.poll() is None:
+            d.status = JobStatus.STOPPED
+            proc.terminate()
+            return True
+        return False
+
+    def list_jobs(self) -> List[JobDetails]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def wait(self, submission_id: str, timeout: float = 300) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            s = self.status(submission_id)
+            if s in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                     JobStatus.STOPPED):
+                return s
+            time.sleep(0.1)
+        return self.status(submission_id)
+
+
+class JobServer:
+    """REST endpoints (reference: dashboard job module HTTP routes)."""
+
+    def __init__(self, manager: Optional[JobManager] = None,
+                 host: str = "127.0.0.1", port: int = 8267):
+        self.manager = manager or JobManager()
+        self.host = host
+        self.port = port
+        self._server = None
+
+    def start(self) -> "JobServer":
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        manager = self.manager
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code, payload):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(json.dumps(payload, default=str).encode())
+
+            def do_POST(self):
+                if self.path == "/api/jobs/":
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    try:
+                        sid = manager.submit(
+                            body["entrypoint"],
+                            submission_id=body.get("submission_id"),
+                            runtime_env=body.get("runtime_env"),
+                            metadata=body.get("metadata"),
+                        )
+                        self._json(200, {"submission_id": sid})
+                    except Exception as e:  # noqa: BLE001
+                        self._json(500, {"error": str(e)})
+                elif self.path.endswith("/stop"):
+                    sid = self.path.split("/")[-2]
+                    self._json(200, {"stopped": manager.stop(sid)})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                if parts[:2] == ["api", "jobs"]:
+                    if len(parts) == 2:
+                        self._json(200, [d.__dict__
+                                         for d in manager.list_jobs()])
+                    elif len(parts) == 3:
+                        try:
+                            self._json(200,
+                                       manager.details(parts[2]).__dict__)
+                        except KeyError:
+                            self._json(404, {"error": "unknown job"})
+                    elif len(parts) == 4 and parts[3] == "logs":
+                        try:
+                            self._json(200, {"logs": manager.logs(parts[2])})
+                        except KeyError:
+                            self._json(404, {"error": "unknown job"})
+                else:
+                    self._json(404, {"error": "not found"})
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="rt-jobs").start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+
+class JobSubmissionClient:
+    """HTTP client (reference: job/sdk.py JobSubmissionClient)."""
+
+    def __init__(self, address: str = "http://127.0.0.1:8267"):
+        self.address = address.rstrip("/")
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None):
+        import urllib.request
+
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.address + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        out = self._request("POST", "/api/jobs/", {
+            "entrypoint": entrypoint, "submission_id": submission_id,
+            "runtime_env": runtime_env, "metadata": metadata,
+        })
+        return out["submission_id"]
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self._request("GET", f"/api/jobs/{submission_id}")["status"]
+
+    def get_job_logs(self, submission_id: str) -> str:
+        return self._request(
+            "GET", f"/api/jobs/{submission_id}/logs")["logs"]
+
+    def stop_job(self, submission_id: str) -> bool:
+        return self._request(
+            "POST", f"/api/jobs/{submission_id}/stop")["stopped"]
+
+    def list_jobs(self) -> List[dict]:
+        return self._request("GET", "/api/jobs")

@@ -1,0 +1,114 @@
+"""Runtime environments: per-task/actor execution context.
+
+Reference analog: ``python/ray/runtime_env/runtime_env.py`` (public
+RuntimeEnv) + ``_private/runtime_env/{working_dir,py_modules,pip,conda}``.
+Supported natively here: ``env_vars`` (applied in the worker before
+execution), ``working_dir`` (staged to a per-job dir and chdir'd,
+sys.path-prepended), ``py_modules`` (paths prepended to sys.path).
+``pip``/``conda`` are declared-but-gated: this environment forbids
+installs, so they validate and raise unless the packages already import.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional
+
+
+class RuntimeEnv(dict):
+    """Validated runtime environment description."""
+
+    KNOWN = {"env_vars", "working_dir", "py_modules", "pip", "conda"}
+
+    def __init__(self, env_vars: Optional[Dict[str, str]] = None,
+                 working_dir: Optional[str] = None,
+                 py_modules: Optional[List[str]] = None,
+                 pip: Optional[List[str]] = None,
+                 conda: Optional[Any] = None, **kwargs):
+        unknown = set(kwargs) - self.KNOWN
+        if unknown:
+            raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
+        super().__init__()
+        if env_vars:
+            if not all(isinstance(k, str) and isinstance(v, str)
+                       for k, v in env_vars.items()):
+                raise TypeError("env_vars must be Dict[str, str]")
+            self["env_vars"] = dict(env_vars)
+        if working_dir:
+            if not os.path.isdir(working_dir):
+                raise ValueError(f"working_dir {working_dir!r} not found")
+            self["working_dir"] = os.path.abspath(working_dir)
+        if py_modules:
+            for m in py_modules:
+                if not os.path.exists(m):
+                    raise ValueError(f"py_module path {m!r} not found")
+            self["py_modules"] = [os.path.abspath(m) for m in py_modules]
+        if pip:
+            self["pip"] = list(pip)
+        if conda:
+            self["conda"] = conda
+
+
+def stage_working_dir(source: str, job_id_hex: str) -> str:
+    """Copy the working dir into the session area (reference: packaging.py
+    zips to GCS KV; single-host staging copies to a shared path)."""
+    target = os.path.join(tempfile.gettempdir(), "rt_runtime_env",
+                          job_id_hex, os.path.basename(source))
+    if not os.path.exists(target):
+        shutil.copytree(source, target)
+    return target
+
+
+def apply_runtime_env(env: Optional[Dict]) -> Dict[str, Any]:
+    """Apply in the worker process before task execution.
+
+    Returns undo info (reference: the runtime-env agent materializes the
+    env before worker start; here workers are generic and apply per-task).
+    """
+    if not env:
+        return {}
+    undo: Dict[str, Any] = {}
+    env_vars = env.get("env_vars")
+    if env_vars:
+        undo["env_vars"] = {k: os.environ.get(k) for k in env_vars}
+        os.environ.update(env_vars)
+    working_dir = env.get("working_dir")
+    if working_dir:
+        undo["cwd"] = os.getcwd()
+        os.chdir(working_dir)
+        sys.path.insert(0, working_dir)
+        undo["sys_path_entry"] = working_dir
+    for mod_path in env.get("py_modules", []):
+        parent = (os.path.dirname(mod_path)
+                  if os.path.isfile(mod_path) else mod_path)
+        sys.path.insert(0, parent)
+        undo.setdefault("extra_paths", []).append(parent)
+    for pkg in env.get("pip", []):
+        name = pkg.split("==")[0].split(">=")[0].replace("-", "_")
+        try:
+            __import__(name)
+        except ImportError as e:
+            raise RuntimeError(
+                f"runtime_env pip package {pkg!r} unavailable and installs "
+                f"are disabled in this environment"
+            ) from e
+    return undo
+
+
+def restore_runtime_env(undo: Dict[str, Any]) -> None:
+    for k, v in (undo.get("env_vars") or {}).items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    if "cwd" in undo:
+        os.chdir(undo["cwd"])
+    entry = undo.get("sys_path_entry")
+    if entry and entry in sys.path:
+        sys.path.remove(entry)
+    for p in undo.get("extra_paths", []):
+        if p in sys.path:
+            sys.path.remove(p)

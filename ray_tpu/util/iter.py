@@ -1,0 +1,130 @@
+"""ParallelIterator: sharded iteration over actors.
+
+Reference analog: ``python/ray/util/iter.py:132`` (ParallelIterator over
+``ParallelIteratorWorker`` actors — the RolloutWorker base class in the
+reference's RLlib). Shards live in actor processes; transforms apply
+per-shard; ``gather_sync`` round-robins batches to the driver.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+from ..core import get, kill, remote
+
+
+class ParallelIteratorWorker:
+    """Actor hosting one shard of the iteration."""
+
+    def __init__(self, items, repeat: bool = False):
+        self._items = list(items)
+        self._repeat = repeat
+        self._transforms: List = []
+        self._it = None
+
+    def add_transform(self, kind: str, fn) -> bool:
+        self._transforms.append((kind, fn))
+        return True
+
+    def _base_iter(self):
+        while True:
+            yield from self._items
+            if not self._repeat:
+                return
+
+    def reset(self) -> bool:
+        it = self._base_iter()
+        for kind, fn in self._transforms:
+            if kind == "map":
+                it = map(fn, it)
+            elif kind == "filter":
+                it = filter(fn, it)
+            elif kind == "flatten":
+                it = (y for x in it for y in x)
+            elif kind == "batch":
+                it = _batched(it, fn)
+        self._it = it
+        return True
+
+    def next_batch(self, n: int = 1):
+        if self._it is None:
+            self.reset()
+        out = []
+        try:
+            for _ in range(n):
+                out.append(next(self._it))
+        except StopIteration:
+            pass
+        return out, len(out) < n
+
+
+def _batched(it, size):
+    batch = []
+    for x in it:
+        batch.append(x)
+        if len(batch) >= size:
+            yield batch
+            batch = []
+    if batch:
+        yield batch
+
+
+class ParallelIterator:
+    def __init__(self, actors: List[Any]):
+        self._actors = actors
+
+    @staticmethod
+    def from_items(items: List[Any], num_shards: int = 2,
+                   repeat: bool = False) -> "ParallelIterator":
+        worker_cls = remote(ParallelIteratorWorker)
+        shards = [items[i::num_shards] for i in range(num_shards)]
+        return ParallelIterator(
+            [worker_cls.remote(s, repeat) for s in shards]
+        )
+
+    def for_each(self, fn: Callable) -> "ParallelIterator":
+        get([a.add_transform.remote("map", fn) for a in self._actors])
+        return self
+
+    def filter(self, fn: Callable) -> "ParallelIterator":
+        get([a.add_transform.remote("filter", fn) for a in self._actors])
+        return self
+
+    def batch(self, n: int) -> "ParallelIterator":
+        get([a.add_transform.remote("batch", n) for a in self._actors])
+        return self
+
+    def flatten(self) -> "ParallelIterator":
+        get([a.add_transform.remote("flatten", None) for a in self._actors])
+        return self
+
+    def num_shards(self) -> int:
+        return len(self._actors)
+
+    def gather_sync(self, batch: int = 16) -> Iterable[Any]:
+        """Round-robin over shards until all exhausted."""
+        get([a.reset.remote() for a in self._actors])
+        live = list(self._actors)
+        while live:
+            done_actors = []
+            for a in live:
+                items, exhausted = get(a.next_batch.remote(batch))
+                yield from items
+                if exhausted:
+                    done_actors.append(a)
+            live = [a for a in live if a not in done_actors]
+
+    def take(self, n: int) -> List[Any]:
+        out = []
+        for x in self.gather_sync():
+            out.append(x)
+            if len(out) >= n:
+                break
+        return out
+
+    def stop(self) -> None:
+        for a in self._actors:
+            try:
+                kill(a)
+            except Exception:
+                pass

@@ -1,0 +1,142 @@
+"""Drop-in ``multiprocessing.Pool`` backed by the task layer.
+
+Reference analog: ``python/ray/util/multiprocessing/pool.py`` — Pool with
+map/starmap/imap/apply/async variants running as remote tasks.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+from ..core import get, remote, wait
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool = False):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        values = get(self._refs, timeout=timeout)
+        return values[0] if self._single else values
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = wait(self._refs, num_returns=len(self._refs), timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Process pool over remote tasks (chunked like stdlib Pool)."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = ()):
+        from ..core.runtime import auto_init
+
+        auto_init()
+        self._processes = processes or 4
+        self._initializer = initializer
+        self._initargs = initargs
+        self._closed = False
+
+    def _task(self):
+        initializer, initargs = self._initializer, self._initargs
+
+        @remote
+        def run_chunk(fn, chunk, star):
+            if initializer is not None:
+                initializer(*initargs)
+            if star:
+                return [fn(*item) for item in chunk]
+            return [fn(item) for item in chunk]
+
+        return run_chunk
+
+    def _chunks(self, iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    # -- sync ----------------------------------------------------------------
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def starmap(self, fn: Callable, iterable: Iterable,
+                chunksize: Optional[int] = None) -> List:
+        return self.starmap_async(fn, iterable, chunksize).get()
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        task = self._task()
+        refs = [task.remote(fn, c, False)
+                for c in self._chunks(iterable, chunksize)]
+        for ref in refs:
+            yield from get(ref)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: Optional[int] = None):
+        task = self._task()
+        refs = [task.remote(fn, c, False)
+                for c in self._chunks(iterable, chunksize)]
+        pending = list(refs)
+        while pending:
+            ready, pending = wait(pending, num_returns=1)
+            yield from get(ready[0])
+
+    # -- async ---------------------------------------------------------------
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        task = self._task()
+        refs = [task.remote(fn, c, False)
+                for c in self._chunks(iterable, chunksize)]
+        return _FlattenResult(refs)
+
+    def starmap_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        task = self._task()
+        refs = [task.remote(fn, c, True)
+                for c in self._chunks(iterable, chunksize)]
+        return _FlattenResult(refs)
+
+    def apply_async(self, fn, args=(), kwds=None) -> AsyncResult:
+        @remote
+        def run_one(fn_, a, k):
+            return fn_(*a, **(k or {}))
+
+        return AsyncResult([run_one.remote(fn, args, kwds)], single=True)
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _FlattenResult(AsyncResult):
+    def get(self, timeout: Optional[float] = None):
+        chunks = get(self._refs, timeout=timeout)
+        return list(itertools.chain.from_iterable(chunks))

@@ -1,0 +1,121 @@
+"""Tests: runtime_env, multiprocessing Pool, ParallelIterator, job submission."""
+
+import time
+
+import pytest
+
+
+def test_runtime_env_env_vars(rt_shared):
+    import ray_tpu as rt
+
+    @rt.remote(runtime_env={"env_vars": {"RT_TEST_VAR": "hello"}})
+    def read():
+        import os
+
+        return os.environ.get("RT_TEST_VAR")
+
+    assert rt.get(read.remote(), timeout=30) == "hello"
+
+
+def test_runtime_env_working_dir(rt_shared, tmp_path):
+    import ray_tpu as rt
+    from ray_tpu.runtime_env import RuntimeEnv
+
+    (tmp_path / "side_mod_abc.py").write_text("X = 'from-working-dir'\n")
+
+    @rt.remote(runtime_env=RuntimeEnv(working_dir=str(tmp_path)))
+    def use():
+        import side_mod_abc
+
+        return side_mod_abc.X
+
+    assert rt.get(use.remote(), timeout=30) == "from-working-dir"
+
+
+def test_runtime_env_validation():
+    from ray_tpu.runtime_env import RuntimeEnv
+
+    with pytest.raises(ValueError):
+        RuntimeEnv(bogus_field=1)
+    with pytest.raises(TypeError):
+        RuntimeEnv(env_vars={"a": 1})
+
+
+def test_mp_pool_map(rt_shared):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(2) as pool:
+        assert pool.map(lambda x: x * x, range(10)) == [
+            i * i for i in range(10)
+        ]
+
+
+def test_mp_pool_starmap_apply(rt_shared):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(2) as pool:
+        assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+        assert pool.apply(lambda x: x + 1, (41,)) == 42
+
+
+def test_mp_pool_imap_unordered(rt_shared):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(2) as pool:
+        out = sorted(pool.imap_unordered(lambda x: x * 2, range(8)))
+    assert out == [i * 2 for i in range(8)]
+
+
+def test_parallel_iterator(rt_shared):
+    from ray_tpu.util.iter import ParallelIterator
+
+    it = ParallelIterator.from_items(list(range(20)), num_shards=2)
+    out = sorted(it.for_each(lambda x: x * 10).gather_sync())
+    assert out == [i * 10 for i in range(20)]
+    it.stop()
+
+
+def test_parallel_iterator_filter_batch(rt_shared):
+    from ray_tpu.util.iter import ParallelIterator
+
+    it = ParallelIterator.from_items(list(range(10)), num_shards=2)
+    batches = list(it.filter(lambda x: x % 2 == 0).batch(2).gather_sync())
+    flat = sorted(x for b in batches for x in b)
+    assert flat == [0, 2, 4, 6, 8]
+    it.stop()
+
+
+def test_job_manager_lifecycle(tmp_path):
+    from ray_tpu.job_submission import JobManager, JobStatus
+
+    mgr = JobManager(log_dir=str(tmp_path))
+    sid = mgr.submit("echo job-output-123 && exit 0")
+    assert mgr.wait(sid, timeout=30) == JobStatus.SUCCEEDED
+    assert "job-output-123" in mgr.logs(sid)
+
+    sid2 = mgr.submit("exit 3")
+    assert mgr.wait(sid2, timeout=30) == JobStatus.FAILED
+    assert mgr.details(sid2).returncode == 3
+
+
+def test_job_http_roundtrip(tmp_path):
+    from ray_tpu.job_submission import (
+        JobManager,
+        JobServer,
+        JobSubmissionClient,
+    )
+
+    server = JobServer(JobManager(log_dir=str(tmp_path)), port=18268).start()
+    try:
+        client = JobSubmissionClient("http://127.0.0.1:18268")
+        sid = client.submit_job(entrypoint="echo from-http")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if client.get_job_status(sid) in ("SUCCEEDED", "FAILED"):
+                break
+            time.sleep(0.1)
+        assert client.get_job_status(sid) == "SUCCEEDED"
+        assert "from-http" in client.get_job_logs(sid)
+        assert any(j["submission_id"] == sid for j in client.list_jobs())
+    finally:
+        server.stop()
