@@ -1,0 +1,20 @@
+"""Model families: GPT-2 (flagship), Llama, ResNet, ViT.
+
+All pure-pytree JAX functions with logical-axis sharding annotations
+(see ``models/common.py``); configs match the tracked baseline set
+(BASELINE.md): GPT-2 355M/1.5B, Llama-2-7B, ResNet-18/CIFAR, ViT-B/16.
+"""
+
+import importlib
+
+__all__ = ["common", "gpt2", "llama", "resnet", "vit"]
+
+
+def __getattr__(name):
+    # Lazy: rollout workers import models.common at actor startup; don't
+    # make every worker pay for loading all model families.
+    if name in __all__:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,261 @@
+"""Llama-architecture LM: RMSNorm, RoPE, GQA, SwiGLU — with KV-cache
+decoding for the Serve inference path.
+
+Baseline config: "Ray Serve Llama-2-7B inference replica (pjit)"
+(``BASELINE.md`` tracked configs). Same pure-pytree + logical-axes design
+as ``gpt2.py``; decode step is a separate jit-compiled function over a
+static-shape KV cache (no dynamic shapes — TPU-friendly continuous
+batching slots into fixed cache pages).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention as attention_op, mha_reference
+from ..parallel.sharding import constrain
+from .common import rms_norm, truncated_normal
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_seq: int = 2048
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    d_model: int = 4096
+    d_mlp: int = 11008
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+CONFIGS = {
+    "llama2-7b": LlamaConfig(),
+    "llama-tiny": LlamaConfig(vocab_size=512, max_seq=128, num_layers=2,
+                              num_heads=4, num_kv_heads=2, d_model=64,
+                              d_mlp=172, dtype=jnp.float32, remat=False),
+    "llama2-13b": LlamaConfig(num_layers=40, num_heads=40, num_kv_heads=40,
+                              d_model=5120, d_mlp=13824),
+}
+
+
+def init_params(key, cfg: LlamaConfig) -> Tuple[Dict, Dict]:
+    keys = jax.random.split(key, 8)
+    d, m, L = cfg.d_model, cfg.d_mlp, cfg.num_layers
+    hd = cfg.head_dim
+    kv_dim = cfg.num_kv_heads * hd
+    params = {
+        "wte": truncated_normal(keys[0], (cfg.vocab_size, d)),
+        "blocks": {
+            "attn_norm": jnp.ones((L, d)),
+            "wq": truncated_normal(keys[1], (L, d, d)),
+            "wk": truncated_normal(keys[2], (L, d, kv_dim)),
+            "wv": truncated_normal(keys[3], (L, d, kv_dim)),
+            "wo": truncated_normal(keys[4], (L, d, d),
+                                   stddev=0.02 / math.sqrt(2 * L)),
+            "ffn_norm": jnp.ones((L, d)),
+            "w_gate": truncated_normal(keys[5], (L, d, m)),
+            "w_up": truncated_normal(keys[6], (L, d, m)),
+            "w_down": truncated_normal(keys[7], (L, m, d),
+                                       stddev=0.02 / math.sqrt(2 * L)),
+        },
+        "final_norm": jnp.ones((d,)),
+    }
+    axes = {
+        "wte": ("vocab", "embed"),
+        "blocks": {
+            "attn_norm": ("layers", None),
+            "wq": ("layers", "embed", "qkv"),
+            "wk": ("layers", "embed", "kv"),
+            "wv": ("layers", "embed", "kv"),
+            "wo": ("layers", "qkv", "embed"),
+            "ffn_norm": ("layers", None),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": (None,),
+    }
+    return params, axes
+
+
+def rope(x, positions, theta: float):
+    """Rotary embeddings. x: [B, H, S, D]; positions: [S] or [B, S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    if positions.ndim == 1:
+        angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
+        angles = angles[None, None]  # [1,1,S,D/2]
+    else:
+        angles = positions[:, None, :, None].astype(jnp.float32) * freqs
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out1 = xf1 * cos - xf2 * sin
+    out2 = xf2 * cos + xf1 * sin
+    out = jnp.stack([out1, out2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def _repeat_kv(x, n_rep: int):
+    if n_rep == 1:
+        return x
+    b, h, s, d = x.shape
+    return jnp.repeat(x, n_rep, axis=1)
+
+
+def _block(x, p, cfg: LlamaConfig, rules, positions):
+    b, s, d = x.shape
+    h, hd, hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
+
+    y = rms_norm(x, p["attn_norm"])
+    q = (y @ p["wq"].astype(y.dtype)).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    k = (y @ p["wk"].astype(y.dtype)).reshape(b, s, hkv, hd).transpose(0, 2, 1, 3)
+    v = (y @ p["wv"].astype(y.dtype)).reshape(b, s, hkv, hd).transpose(0, 2, 1, 3)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    k = _repeat_kv(k, h // hkv)
+    v = _repeat_kv(v, h // hkv)
+    o = attention_op(q, k, v, causal=True)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+    o = o @ p["wo"].astype(o.dtype)
+    x = x + constrain(o, ("batch", "seq", None), rules)
+
+    y = rms_norm(x, p["ffn_norm"])
+    gate = jax.nn.silu(y @ p["w_gate"].astype(y.dtype))
+    up = y @ p["w_up"].astype(y.dtype)
+    hidden = constrain(gate * up, ("batch", "seq", "mlp"), rules)
+    out = hidden @ p["w_down"].astype(hidden.dtype)
+    return x + constrain(out, ("batch", "seq", None), rules)
+
+
+def forward(params, tokens, cfg: LlamaConfig, rules=None):
+    """tokens [B, S] -> logits [B, S, vocab] (training/prefill path)."""
+    b, s = tokens.shape
+    x = params["wte"][tokens].astype(cfg.dtype)
+    positions = jnp.arange(s)
+    block = partial(_block, cfg=cfg, rules=rules, positions=positions)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(x, layer):
+        return block(x, layer), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x = rms_norm(x, params["final_norm"])
+    return jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(cfg.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def loss_fn(params, batch, cfg: LlamaConfig, rules=None):
+    from .common import cross_entropy_loss
+
+    tokens = batch["tokens"]
+    logits = forward(params, tokens[:, :-1], cfg, rules)
+    loss, _ = cross_entropy_loss(logits, tokens[:, 1:])
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decoding (serve path): static cache [L, B, Hkv, max_seq, hd].
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(cfg: LlamaConfig, batch: int):
+    shape = (cfg.num_layers, batch, cfg.num_kv_heads, cfg.max_seq,
+             cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def decode_step(params, cache, tokens, pos, cfg: LlamaConfig):
+    """One decode step: tokens [B] at position ``pos`` (scalar int array).
+
+    Returns (logits [B, vocab], new_cache). Static shapes; masked attention
+    over the cache prefix.
+    """
+    b = tokens.shape[0]
+    h, hd, hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
+    x = params["wte"][tokens].astype(cfg.dtype)[:, None, :]  # [B,1,D]
+    positions = jnp.full((1,), pos)
+
+    def layer_step(carry, inputs):
+        x = carry
+        layer_params, k_cache, v_cache = inputs
+        p = layer_params
+        y = rms_norm(x, p["attn_norm"])
+        q = (y @ p["wq"].astype(y.dtype)).reshape(b, 1, h, hd).transpose(
+            0, 2, 1, 3)
+        k_new = (y @ p["wk"].astype(y.dtype)).reshape(b, 1, hkv, hd).transpose(
+            0, 2, 1, 3)
+        v_new = (y @ p["wv"].astype(y.dtype)).reshape(b, 1, hkv, hd).transpose(
+            0, 2, 1, 3)
+        q = rope(q, positions, cfg.rope_theta)
+        k_new = rope(k_new, positions, cfg.rope_theta)
+        k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k_new, pos, 2)
+        v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v_new, pos, 2)
+        k = _repeat_kv(k_cache, h // hkv)
+        v = _repeat_kv(v_cache, h // hkv)
+        scale = 1.0 / math.sqrt(hd)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        mask = jnp.arange(cfg.max_seq)[None, None, None, :] <= pos
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+        o = o.transpose(0, 2, 1, 3).reshape(b, 1, cfg.d_model)
+        x = x + o @ p["wo"].astype(o.dtype)
+        y = rms_norm(x, p["ffn_norm"])
+        gate = jax.nn.silu(y @ p["w_gate"].astype(y.dtype))
+        up = y @ p["w_up"].astype(y.dtype)
+        x = x + (gate * up) @ p["w_down"].astype(y.dtype)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x[:, 0], params["final_norm"])
+    logits = jnp.einsum("bd,vd->bv", x, params["wte"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def generate(params, prompt_tokens, cfg: LlamaConfig, max_new: int = 32,
+             temperature: float = 0.0, key=None):
+    """Greedy/sampled generation (the serve replica's inner loop)."""
+    if temperature > 0 and key is None:
+        raise ValueError("temperature > 0 requires a PRNG key")
+    b, s = prompt_tokens.shape
+    cache = init_kv_cache(cfg, b)
+    # Prefill one token at a time keeps this reference implementation
+    # simple; the serve bench uses jit(decode_step) so the per-step cost
+    # is one compiled program either way.
+    step = jax.jit(partial(decode_step, cfg=cfg))
+    tokens = prompt_tokens
+    logits = None
+    for i in range(s):
+        logits, cache = step(params, cache, tokens[:, i], jnp.asarray(i))
+    out = [tokens]
+    cur = None
+    for j in range(max_new):
+        if temperature > 0:
+            key, sub = jax.random.split(key)
+            cur = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            cur = jnp.argmax(logits, axis=-1)
+        out.append(cur[:, None])
+        logits, cache = step(params, cache, cur, jnp.asarray(s + j))
+    return jnp.concatenate(out, axis=1)

@@ -1,0 +1,161 @@
+"""ResNet family (CIFAR + ImageNet stems) in pure-pytree JAX.
+
+Baseline config: "Ray Train TorchTrainer ResNet-18 CIFAR-10"
+(``BASELINE.md`` tracked configs). Convs run NHWC (TPU-native layout);
+batch norm uses accumulated EMA statistics carried alongside params.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .common import cross_entropy_loss, truncated_normal
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (2, 2, 2, 2)  # resnet-18
+    num_classes: int = 10
+    width: int = 64
+    cifar_stem: bool = True  # 3x3/stride-1 stem, no maxpool
+    dtype: Any = jnp.float32
+
+
+CONFIGS = {
+    "resnet18-cifar": ResNetConfig(),
+    "resnet34-cifar": ResNetConfig(stage_sizes=(3, 4, 6, 3)),
+    "resnet18-imagenet": ResNetConfig(cifar_stem=False, num_classes=1000),
+}
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return truncated_normal(key, (kh, kw, cin, cout),
+                            stddev=math.sqrt(2.0 / fan_in))
+
+
+def conv(x, w, stride: int = 1, padding: str = "SAME"):
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+
+
+def batch_norm(x, scale, bias, mean, var, training: bool,
+               momentum: float = 0.9, eps: float = 1e-5):
+    """Returns (y, new_mean, new_var)."""
+    if training:
+        axes = (0, 1, 2)
+        m = jnp.mean(x.astype(jnp.float32), axes)
+        v = jnp.var(x.astype(jnp.float32), axes)
+        new_mean = momentum * mean + (1 - momentum) * m
+        new_var = momentum * var + (1 - momentum) * v
+    else:
+        m, v = mean, var
+        new_mean, new_var = mean, var
+    y = (x.astype(jnp.float32) - m) * jax.lax.rsqrt(v + eps)
+    y = y * scale + bias
+    return y.astype(x.dtype), new_mean, new_var
+
+
+def init_params(key, cfg: ResNetConfig) -> Tuple[Dict, Dict]:
+    """Returns (params, batch_stats)."""
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    keys = iter(jax.random.split(key, 256))
+
+    def bn(name, c):
+        params[f"{name}_scale"] = jnp.ones((c,))
+        params[f"{name}_bias"] = jnp.zeros((c,))
+        stats[f"{name}_mean"] = jnp.zeros((c,))
+        stats[f"{name}_var"] = jnp.ones((c,))
+
+    w = cfg.width
+    if cfg.cifar_stem:
+        params["stem_conv"] = _conv_init(next(keys), 3, 3, 3, w)
+    else:
+        params["stem_conv"] = _conv_init(next(keys), 7, 7, 3, w)
+    bn("stem_bn", w)
+
+    cin = w
+    for s, blocks in enumerate(cfg.stage_sizes):
+        cout = w * (2 ** s)
+        for b in range(blocks):
+            prefix = f"s{s}b{b}"
+            stride = 2 if (b == 0 and s > 0) else 1
+            params[f"{prefix}_conv1"] = _conv_init(next(keys), 3, 3, cin, cout)
+            bn(f"{prefix}_bn1", cout)
+            params[f"{prefix}_conv2"] = _conv_init(next(keys), 3, 3, cout, cout)
+            bn(f"{prefix}_bn2", cout)
+            if stride != 1 or cin != cout:
+                params[f"{prefix}_proj"] = _conv_init(
+                    next(keys), 1, 1, cin, cout)
+                bn(f"{prefix}_proj_bn", cout)
+            cin = cout
+    params["head_w"] = truncated_normal(next(keys), (cin, cfg.num_classes),
+                                        stddev=0.01)
+    params["head_b"] = jnp.zeros((cfg.num_classes,))
+    return params, stats
+
+
+def forward(params: Dict, stats: Dict, images, cfg: ResNetConfig,
+            training: bool = False):
+    """images [B, H, W, 3] -> (logits [B, classes], new_stats)."""
+    new_stats = dict(stats)
+
+    def apply_bn(name, x):
+        y, m, v = batch_norm(
+            x, params[f"{name}_scale"], params[f"{name}_bias"],
+            stats[f"{name}_mean"], stats[f"{name}_var"], training,
+        )
+        new_stats[f"{name}_mean"] = m
+        new_stats[f"{name}_var"] = v
+        return y
+
+    x = images.astype(cfg.dtype)
+    if cfg.cifar_stem:
+        x = conv(x, params["stem_conv"], 1)
+    else:
+        x = conv(x, params["stem_conv"], 2, padding=[(3, 3), (3, 3)])
+    x = jax.nn.relu(apply_bn("stem_bn", x))
+    if not cfg.cifar_stem:
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+        )
+
+    cin = cfg.width
+    for s, blocks in enumerate(cfg.stage_sizes):
+        cout = cfg.width * (2 ** s)
+        for b in range(blocks):
+            prefix = f"s{s}b{b}"
+            stride = 2 if (b == 0 and s > 0) else 1
+            shortcut = x
+            y = conv(x, params[f"{prefix}_conv1"], stride)
+            y = jax.nn.relu(apply_bn(f"{prefix}_bn1", y))
+            y = conv(y, params[f"{prefix}_conv2"], 1)
+            y = apply_bn(f"{prefix}_bn2", y)
+            if f"{prefix}_proj" in params:
+                shortcut = conv(shortcut, params[f"{prefix}_proj"], stride)
+                shortcut = apply_bn(f"{prefix}_proj_bn", shortcut)
+            x = jax.nn.relu(y + shortcut)
+            cin = cout
+
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    logits = x @ params["head_w"] + params["head_b"]
+    return logits, new_stats
+
+
+def loss_fn(params, stats, batch, cfg: ResNetConfig, training: bool = True):
+    """batch: {"image": [B,H,W,3], "label": [B]} -> (loss, (new_stats, acc))."""
+    logits, new_stats = forward(params, stats, batch["image"], cfg, training)
+    labels = batch["label"]
+    loss, _ = cross_entropy_loss(logits, labels)
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, (new_stats, acc)

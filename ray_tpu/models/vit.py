@@ -1,0 +1,168 @@
+"""Vision Transformer (ViT-B/16 family).
+
+Baseline config: "Ray Tune + Train PBT sweep of ViT-B/16" (``BASELINE.md``
+tracked configs). Reuses the transformer-block structure of ``gpt2.py``
+with bidirectional attention, patch embedding, class token, and the same
+logical-axis annotations so the dp/fsdp/tp rule table applies unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention as attention_op
+from ..parallel.sharding import constrain
+from .common import cross_entropy_loss, layer_norm, truncated_normal
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_mlp: int = 3072
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+CONFIGS = {
+    "vit-b16": ViTConfig(),
+    "vit-s16": ViTConfig(num_layers=12, num_heads=6, d_model=384, d_mlp=1536),
+    "vit-b16-cifar": ViTConfig(image_size=32, patch_size=4, num_classes=10),
+}
+
+
+def init_params(key, cfg: ViTConfig) -> Tuple[Dict, Dict]:
+    keys = jax.random.split(key, 8)
+    d, m, L = cfg.d_model, cfg.d_mlp, cfg.num_layers
+    patch_dim = cfg.patch_size * cfg.patch_size * 3
+    params = {
+        "patch_w": truncated_normal(keys[0], (patch_dim, d)),
+        "patch_b": jnp.zeros((d,)),
+        "cls_token": truncated_normal(keys[1], (1, 1, d)),
+        "pos_embed": truncated_normal(keys[2], (cfg.num_patches + 1, d),
+                                      stddev=0.01),
+        "blocks": {
+            "ln1_scale": jnp.ones((L, d)),
+            "ln1_bias": jnp.zeros((L, d)),
+            "qkv_w": truncated_normal(keys[3], (L, d, 3 * d)),
+            "qkv_b": jnp.zeros((L, 3 * d)),
+            "proj_w": truncated_normal(
+                keys[4], (L, d, d), stddev=0.02 / math.sqrt(2 * L)),
+            "proj_b": jnp.zeros((L, d)),
+            "ln2_scale": jnp.ones((L, d)),
+            "ln2_bias": jnp.zeros((L, d)),
+            "mlp_in_w": truncated_normal(keys[5], (L, d, m)),
+            "mlp_in_b": jnp.zeros((L, m)),
+            "mlp_out_w": truncated_normal(
+                keys[6], (L, m, d), stddev=0.02 / math.sqrt(2 * L)),
+            "mlp_out_b": jnp.zeros((L, d)),
+        },
+        "lnf_scale": jnp.ones((d,)),
+        "lnf_bias": jnp.zeros((d,)),
+        "head_w": jnp.zeros((d, cfg.num_classes)),
+        "head_b": jnp.zeros((cfg.num_classes,)),
+    }
+    axes = {
+        "patch_w": (None, "embed"),
+        "patch_b": ("embed",),
+        "cls_token": (None, None, "embed"),
+        "pos_embed": (None, "embed"),
+        "blocks": {
+            "ln1_scale": ("layers", None), "ln1_bias": ("layers", None),
+            "qkv_w": ("layers", "embed", "qkv"),
+            "qkv_b": ("layers", "qkv"),
+            "proj_w": ("layers", "qkv", "embed"),
+            "proj_b": ("layers", "embed"),
+            "ln2_scale": ("layers", None), "ln2_bias": ("layers", None),
+            "mlp_in_w": ("layers", "embed", "mlp"),
+            "mlp_in_b": ("layers", "mlp"),
+            "mlp_out_w": ("layers", "mlp", "embed"),
+            "mlp_out_b": ("layers", "embed"),
+        },
+        "lnf_scale": (None,), "lnf_bias": (None,),
+        "head_w": ("embed", None), "head_b": (None,),
+    }
+    return params, axes
+
+
+def patchify(images, patch: int):
+    """[B, H, W, 3] -> [B, n_patches, patch*patch*3]."""
+    b, h, w, c = images.shape
+    ph, pw = h // patch, w // patch
+    x = images.reshape(b, ph, patch, pw, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, ph * pw, patch * patch * c)
+
+
+def _block(x, p, cfg: ViTConfig, rules):
+    b, s, d = x.shape
+    h, hd = cfg.num_heads, cfg.head_dim
+    y = layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+    qkv = (y @ p["qkv_w"].astype(y.dtype)) + p["qkv_b"].astype(y.dtype)
+    qkv = constrain(qkv, ("batch", "seq", "qkv"), rules)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+
+    o = attention_op(heads(q), heads(k), heads(v), causal=False)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+    o = (o @ p["proj_w"].astype(o.dtype)) + p["proj_b"].astype(o.dtype)
+    x = x + constrain(o, ("batch", "seq", None), rules)
+
+    y = layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    hdn = (y @ p["mlp_in_w"].astype(y.dtype)) + p["mlp_in_b"].astype(y.dtype)
+    hdn = constrain(hdn, ("batch", "seq", "mlp"), rules)
+    hdn = jax.nn.gelu(hdn, approximate=True)
+    out = (hdn @ p["mlp_out_w"].astype(hdn.dtype)) + p["mlp_out_b"].astype(
+        hdn.dtype)
+    return x + constrain(out, ("batch", "seq", None), rules)
+
+
+def forward(params, images, cfg: ViTConfig, rules=None):
+    """images [B, H, W, 3] -> logits [B, classes]."""
+    patches = patchify(images.astype(cfg.dtype), cfg.patch_size)
+    x = patches @ params["patch_w"].astype(cfg.dtype) + params[
+        "patch_b"].astype(cfg.dtype)
+    cls = jnp.broadcast_to(
+        params["cls_token"].astype(cfg.dtype),
+        (x.shape[0], 1, cfg.d_model),
+    )
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"][: x.shape[1]].astype(cfg.dtype)[None]
+
+    block = partial(_block, cfg=cfg, rules=rules)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(x, layer):
+        return block(x, layer), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    cls_out = x[:, 0].astype(jnp.float32)
+    return cls_out @ params["head_w"].astype(jnp.float32) + params["head_b"]
+
+
+def loss_fn(params, batch, cfg: ViTConfig, rules=None):
+    logits = forward(params, batch["image"], cfg, rules)
+    loss, _ = cross_entropy_loss(logits, batch["label"])
+    return loss
