@@ -6,14 +6,23 @@ RolloutWorker/WorkerSet, SampleBatch, env abstractions).
 """
 
 from .algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from .dqn import DQN, DQNConfig
 from .env import FastCartPole, GymVectorEnv, VectorEnv, make_env
 from .policy import JaxPolicy
 from .ppo import PPO, PPOConfig
+from .replay_buffers import (
+    MultiAgentReplayBuffer,
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+    ReservoirReplayBuffer,
+)
 from .rollout_worker import RolloutWorker
 from .sample_batch import SampleBatch, compute_gae
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "FastCartPole", "GymVectorEnv",
-    "JaxPolicy", "PPO", "PPOConfig", "RolloutWorker", "SampleBatch",
-    "VectorEnv", "WorkerSet", "compute_gae", "make_env",
+    "Algorithm", "AlgorithmConfig", "DQN", "DQNConfig", "FastCartPole",
+    "GymVectorEnv", "JaxPolicy", "MultiAgentReplayBuffer", "PPO",
+    "PPOConfig", "PrioritizedReplayBuffer", "ReplayBuffer",
+    "ReservoirReplayBuffer", "RolloutWorker", "SampleBatch", "VectorEnv",
+    "WorkerSet", "compute_gae", "make_env",
 ]

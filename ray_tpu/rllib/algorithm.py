@@ -90,23 +90,34 @@ class WorkerSet:
     remote workers; ``sync_weights`` (:205) broadcasts learner weights.
     """
 
-    def __init__(self, config: AlgorithmConfig):
+    def __init__(self, config: AlgorithmConfig, worker_cls=None):
         self.config = config
-        self.local_worker = RolloutWorker(
+        worker_cls = worker_cls or RolloutWorker
+        self.local_worker = worker_cls(
             config.env, config.num_envs_per_worker,
             {"hidden": config.policy_hidden}, seed=config.seed,
         )
         self.remote_workers: List[Any] = []
         if config.num_rollout_workers > 0:
-            worker_cls = remote(RolloutWorker)
+            remote_cls = remote(worker_cls)
             self.remote_workers = [
-                worker_cls.options(num_cpus=1).remote(
+                remote_cls.options(num_cpus=1).remote(
                     config.env, config.num_envs_per_worker,
                     {"hidden": config.policy_hidden},
                     seed=config.seed, worker_index=i + 1,
                 )
                 for i in range(config.num_rollout_workers)
             ]
+
+    def foreach_worker(self, fn: Callable) -> List[Any]:
+        """Apply fn to the local worker inline and to each remote worker
+        via a __call__-style proxy method (reference:
+        WorkerSet.foreach_worker)."""
+        results = [fn(self.local_worker)]
+        if self.remote_workers:
+            results.extend(get([w.apply.remote(fn)
+                                for w in self.remote_workers]))
+        return results
 
     def sync_weights(self, weights: Dict) -> None:
         if self.remote_workers:
@@ -138,6 +149,10 @@ class WorkerSet:
 class Algorithm:
     """Trainable-style base (train/save/restore/stop)."""
 
+    # Subclasses override to swap the rollout worker implementation
+    # (e.g. DQN's transition-collecting worker).
+    _worker_cls = RolloutWorker
+
     def __init__(self, config: AlgorithmConfig):
         from ..core import runtime as runtime_mod
 
@@ -148,7 +163,7 @@ class Algorithm:
         self.setup(config)
 
     def setup(self, config: AlgorithmConfig) -> None:
-        self.workers = WorkerSet(config)
+        self.workers = WorkerSet(config, worker_cls=type(self)._worker_cls)
 
     def training_step(self) -> Dict:
         raise NotImplementedError

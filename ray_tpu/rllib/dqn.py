@@ -1,0 +1,302 @@
+"""DQN: double-DQN with prioritized replay on a JAX learner.
+
+Reference analog: ``rllib/algorithms/dqn/`` (DQNConfig, DQN,
+``dqn_torch_policy.py`` loss: double-Q bootstrapping, huber TD loss,
+n-step targets, prioritized replay feedback) — re-founded on JAX: the
+Q-network is a param pytree, the update is one jit-compiled program on
+the learner device, and TD errors flow back to the sum-tree priorities.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.common import truncated_normal
+from .algorithm import Algorithm, AlgorithmConfig
+from .replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
+from .rollout_worker import RolloutWorker
+from .sample_batch import (
+    ACTIONS,
+    DONES,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+
+
+def init_q_net(key, obs_dim: int, num_actions: int,
+               hidden=(256, 256)) -> Dict:
+    params = {}
+    sizes = [obs_dim] + list(hidden)
+    keys = jax.random.split(key, len(sizes) + 1)
+    for i in range(len(sizes) - 1):
+        std = float(np.sqrt(2.0 / sizes[i]))
+        params[f"t{i}_w"] = truncated_normal(
+            keys[i], (sizes[i], sizes[i + 1]), stddev=std)
+        params[f"t{i}_b"] = jnp.zeros((sizes[i + 1],))
+    params["q_w"] = truncated_normal(keys[-1], (sizes[-1], num_actions),
+                                     stddev=0.01)
+    params["q_b"] = jnp.zeros((num_actions,))
+    return params
+
+
+def q_values(params: Dict, obs: jnp.ndarray) -> jnp.ndarray:
+    x = obs.astype(jnp.float32)
+    i = 0
+    while f"t{i}_w" in params:
+        x = jax.nn.relu(x @ params[f"t{i}_w"] + params[f"t{i}_b"])
+        i += 1
+    return x @ params["q_w"] + params["q_b"]
+
+
+@functools.partial(jax.jit, static_argnums=())
+def _greedy_actions(params, obs):
+    return jnp.argmax(q_values(params, obs), axis=-1)
+
+
+class QPolicy:
+    """Epsilon-greedy policy over a Q-MLP (CPU-jit on rollout workers)."""
+
+    def __init__(self, obs_shape: Tuple[int, ...], num_actions: int,
+                 hidden=(256, 256), seed: int = 0):
+        self.obs_dim = int(np.prod(obs_shape))
+        self.num_actions = num_actions
+        self.params = init_q_net(jax.random.PRNGKey(seed), self.obs_dim,
+                                 num_actions, hidden)
+        self.epsilon = 1.0
+        self._rng = np.random.default_rng(seed + 1)
+
+    def compute_actions(self, obs: np.ndarray, deterministic: bool = False):
+        obs = np.asarray(obs, np.float32).reshape(len(obs), -1)
+        greedy = np.asarray(_greedy_actions(self.params, jnp.asarray(obs)))
+        if deterministic or self.epsilon <= 0:
+            actions = greedy
+        else:
+            explore = self._rng.random(len(obs)) < self.epsilon
+            randoms = self._rng.integers(0, self.num_actions, len(obs))
+            actions = np.where(explore, randoms, greedy)
+        zeros = np.zeros(len(obs), np.float32)
+        return actions.astype(np.int32), zeros, zeros
+
+    def get_weights(self) -> Dict:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Dict) -> None:
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
+class DQNRolloutWorker(RolloutWorker):
+    """Collects flat (s, a, r, s', done) transitions for replay.
+
+    Unlike the on-policy fragment sampler, episode boundaries matter only
+    through the ``dones`` mask, so rows are emitted [T*N] row-major.
+    """
+
+    def _make_policy(self, cfg: Dict, seed: int):
+        return QPolicy(
+            self.env.observation_space_shape, self.env.num_actions,
+            hidden=cfg.get("hidden", (256, 256)), seed=seed,
+        )
+
+    def set_epsilon(self, epsilon: float) -> None:
+        self.policy.epsilon = float(epsilon)
+
+    def sample(self, rollout_length: int = 64) -> SampleBatch:
+        n = self.env.num_envs
+        shape = tuple(self.env.observation_space_shape)
+        obs_buf = np.empty((rollout_length, n) + shape, np.float32)
+        nobs_buf = np.empty((rollout_length, n) + shape, np.float32)
+        act_buf = np.empty((rollout_length, n), np.int32)
+        rew_buf = np.empty((rollout_length, n), np.float32)
+        done_buf = np.empty((rollout_length, n), bool)
+        for t in range(rollout_length):
+            actions, _, _ = self.policy.compute_actions(self._obs)
+            obs_buf[t] = self._obs
+            act_buf[t] = actions
+            next_obs, rewards, dones, _ = self.env.vector_step(actions)
+            # next_obs at a done is the auto-reset obs; the (1 - done)
+            # mask in the TD target makes the bootstrap ignore it.
+            nobs_buf[t] = next_obs
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+            self._episode_rewards += rewards
+            for i in np.nonzero(dones)[0]:
+                self._completed.append(float(self._episode_rewards[i]))
+                self._episode_rewards[i] = 0.0
+            self._obs = next_obs
+        flat = lambda a: a.reshape((-1,) + a.shape[2:])
+        return SampleBatch({
+            OBS: flat(obs_buf), ACTIONS: flat(act_buf),
+            REWARDS: flat(rew_buf), DONES: flat(done_buf),
+            NEXT_OBS: flat(nobs_buf),
+        })
+
+
+def dqn_loss(params, target_params, batch, gamma: float,
+             double_q: bool = True):
+    """(Double-)DQN huber TD loss; returns (loss, |td_error|)."""
+    q = q_values(params, batch[OBS])
+    q_taken = jnp.take_along_axis(
+        q, batch[ACTIONS][:, None].astype(jnp.int32), axis=-1)[:, 0]
+    next_target = q_values(target_params, batch[NEXT_OBS])
+    if double_q:  # action chosen by the online net, valued by the target
+        next_a = jnp.argmax(q_values(params, batch[NEXT_OBS]), axis=-1)
+    else:  # vanilla DQN: target net picks and values
+        next_a = jnp.argmax(next_target, axis=-1)
+    next_q = jnp.take_along_axis(next_target, next_a[:, None], axis=-1)[:, 0]
+    not_done = 1.0 - batch[DONES].astype(jnp.float32)
+    target = batch[REWARDS] + gamma * not_done * next_q
+    td = q_taken - jax.lax.stop_gradient(target)
+    huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                      jnp.abs(td) - 0.5)
+    weights = batch.get("weights")
+    if weights is None:
+        loss = jnp.mean(huber)
+    else:
+        loss = jnp.mean(weights * huber)
+    return loss, jnp.abs(td)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self._algo_class = DQN
+        self.lr = 5e-4
+        self.rollout_fragment_length = 32
+        self.train_batch_size = 64
+        self.buffer_capacity = 100_000
+        self.prioritized_replay = True
+        self.prioritized_alpha = 0.6
+        self.prioritized_beta = 0.4
+        self.learning_starts = 1_000
+        self.target_network_update_freq = 500  # in learner updates
+        self.num_updates_per_iter = 16
+        self.epsilon_timesteps = 10_000  # linear 1.0 -> final_epsilon
+        self.final_epsilon = 0.02
+        self.double_q = True
+        self.policy_hidden = (256, 256)
+
+    def training(self, **kwargs) -> "DQNConfig":
+        for k in ("buffer_capacity", "prioritized_replay",
+                  "prioritized_alpha", "prioritized_beta", "learning_starts",
+                  "target_network_update_freq", "num_updates_per_iter",
+                  "epsilon_timesteps", "final_epsilon", "double_q"):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        super().training(**kwargs)
+        return self
+
+
+class DQN(Algorithm):
+    """training_step: sample → replay add → K learner updates → sync.
+
+    Reference: ``dqn.py DQN.training_step`` — sample, store, sample from
+    buffer, train, update priorities, periodically update target net.
+    """
+
+    _worker_cls = DQNRolloutWorker
+
+    def setup(self, config: DQNConfig) -> None:
+        import optax
+
+        super().setup(config)
+        if config.prioritized_replay:
+            self.buffer: ReplayBuffer = PrioritizedReplayBuffer(
+                config.buffer_capacity, alpha=config.prioritized_alpha,
+                seed=config.seed)
+        else:
+            self.buffer = ReplayBuffer(config.buffer_capacity,
+                                       seed=config.seed)
+        policy = self.workers.local_worker.policy
+        self.params = policy.params
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._num_updates = 0
+
+        gamma, double_q = config.gamma, config.double_q
+
+        @jax.jit
+        def update(params, target_params, opt_state, batch):
+            (loss, td), grads = jax.value_and_grad(
+                dqn_loss, has_aux=True)(params, target_params, batch, gamma,
+                                        double_q)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        self._update = update
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._timesteps_total / max(cfg.epsilon_timesteps, 1))
+        return 1.0 + frac * (cfg.final_epsilon - 1.0)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        eps = self._epsilon()
+        self.workers.foreach_worker(lambda w: w.set_epsilon(eps))
+        batches = self.workers.sample(cfg.rollout_fragment_length)
+        new_steps = 0
+        for b in batches:
+            self.buffer.add(b)
+            new_steps += b.count
+        self._timesteps_total += new_steps
+
+        losses = []
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iter):
+                if isinstance(self.buffer, PrioritizedReplayBuffer):
+                    batch = self.buffer.sample(cfg.train_batch_size,
+                                               beta=cfg.prioritized_beta)
+                else:
+                    batch = self.buffer.sample(cfg.train_batch_size)
+                jbatch = {k: jnp.asarray(v) for k, v in batch.items()
+                          if k != "batch_indexes"}
+                self.params, self.opt_state, loss, td = self._update(
+                    self.params, self.target_params, self.opt_state, jbatch)
+                if isinstance(self.buffer, PrioritizedReplayBuffer):
+                    self.buffer.update_priorities(
+                        batch["batch_indexes"], np.asarray(td))
+                self._num_updates += 1
+                if self._num_updates % cfg.target_network_update_freq == 0:
+                    self.target_params = jax.tree.map(jnp.copy, self.params)
+                losses.append(float(loss))
+            weights = jax.tree.map(np.asarray, self.params)
+            self.workers.local_worker.set_weights(weights)
+            self.workers.sync_weights(weights)
+
+        return {
+            "timesteps_this_iter": new_steps,
+            "num_learner_updates": self._num_updates,
+            "epsilon": eps,
+            "replay_buffer_size": len(self.buffer),
+            "loss": float(np.mean(losses)) if losses else None,
+        }
+
+    def get_state(self) -> Dict:
+        state = super().get_state()
+        state.update({
+            "params": jax.tree.map(np.asarray, self.params),
+            "target_params": jax.tree.map(np.asarray, self.target_params),
+            "num_updates": self._num_updates,
+        })
+        return state
+
+    def set_state(self, state: Dict) -> None:
+        super().set_state(state)
+        if "params" in state:
+            self.params = jax.tree.map(jnp.asarray, state["params"])
+            self.target_params = jax.tree.map(
+                jnp.asarray, state["target_params"])
+            self._num_updates = state.get("num_updates", 0)
+            weights = jax.tree.map(np.asarray, self.params)
+            self.workers.local_worker.set_weights(weights)
+            self.workers.sync_weights(weights)

@@ -41,16 +41,23 @@ class RolloutWorker:
         except Exception:
             pass
         self.env = make_env(env_spec, num_envs, seed + worker_index * 1000)
-        cfg = policy_config or {}
-        self.policy = JaxPolicy(
-            self.env.observation_space_shape, self.env.num_actions,
-            hidden=cfg.get("hidden", (64, 64)),
-            seed=seed + worker_index,
-        )
+        self.policy = self._make_policy(policy_config or {},
+                                        seed + worker_index)
         self._obs = self.env.vector_reset(seed=seed + worker_index * 1000)
         self._episode_rewards = np.zeros(self.env.num_envs, np.float32)
         self._completed: list = []
         self.worker_index = worker_index
+
+    def _make_policy(self, cfg: Dict, seed: int):
+        """Subclass hook: build the policy for this worker's env."""
+        return JaxPolicy(
+            self.env.observation_space_shape, self.env.num_actions,
+            hidden=cfg.get("hidden", (64, 64)), seed=seed,
+        )
+
+    def apply(self, fn) -> Any:
+        """Run fn(self) in the worker (reference: RolloutWorker.apply)."""
+        return fn(self)
 
     def set_weights(self, weights: Dict) -> None:
         self.policy.set_weights(weights)

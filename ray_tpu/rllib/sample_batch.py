@@ -37,6 +37,9 @@ class SampleBatch(dict):
             return 0
         return len(next(iter(self.values())))
 
+    def slice(self, start: int, end: int) -> "SampleBatch":
+        return SampleBatch({k: v[start:end] for k, v in self.items()})
+
     @staticmethod
     def concat_samples(batches: List["SampleBatch"]) -> "SampleBatch":
         if not batches:
