@@ -70,7 +70,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+    # CRITICAL for MXU throughput: matmul operands stay in bf16 — only the
+    # accumulator is fp32 (preferred_element_type). Casting inputs to fp32
+    # first would push the dots off the fast MXU path (~8x slower).
+    q = q_ref[0]  # [block_q, D], input dtype
     d = q.shape[-1]
 
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
@@ -91,12 +94,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     def body(kb, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [block_q, block_k]
+        ) * scale  # [block_q, block_k] fp32
         if causal:
             k_pos = (
                 jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -108,7 +111,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
@@ -180,38 +183,41 @@ def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, res, do):
     """Blockwise backward in plain XLA: recompute P per K block from the
-    saved LSE (no S×S materialization across blocks)."""
+    saved LSE (no S×S materialization across blocks).
+
+    Matmul operands stay in the input dtype (bf16) — only accumulation is
+    fp32 via ``preferred_element_type`` — so every einsum rides the MXU
+    fast path; intermediates P/dS are cast down before re-entering dots.
+    """
     q, k, v, o, lse = res
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
     sq, sk = q.shape[2], k.shape[2]
 
-    # delta = rowsum(dO * O)
-    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # [B,H,Sq]
+    # delta = rowsum(dO * O), fp32 elementwise (cheap, bandwidth-bound)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)  # [B,H,Sq]
 
     n_blocks = max(1, sk // block_k)
 
     def body(kb, carry):
         dq, dk, dv = carry
-        ks = jax.lax.dynamic_slice_in_dim(kf, kb * block_k, block_k, axis=2)
-        vs = jax.lax.dynamic_slice_in_dim(vf, kb * block_k, block_k, axis=2)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, ks,
+        ks = jax.lax.dynamic_slice_in_dim(k, kb * block_k, block_k, axis=2)
+        vs = jax.lax.dynamic_slice_in_dim(v, kb * block_k, block_k, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, ks,
                        preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = jnp.arange(sq)[:, None]
             k_pos = jnp.arange(block_k)[None, :] + kb * block_k
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[..., None])  # [B,H,Sq,block_k]
-        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, dof,
+        p = jnp.exp(s - lse[..., None])  # [B,H,Sq,block_k] fp32
+        p_lo = p.astype(q.dtype)
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p_lo, do,
                             preferred_element_type=jnp.float32)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vs,
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do, vs,
                         preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[..., None]) * scale
+        ds = (p * (dp - delta[..., None]) * scale).astype(q.dtype)
         dq_blk = jnp.einsum("bhqk,bhkd->bhqd", ds, ks,
                             preferred_element_type=jnp.float32)
-        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf,
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, q,
                             preferred_element_type=jnp.float32)
         dk = jax.lax.dynamic_update_slice_in_dim(
             dk, dk_blk, kb * block_k, axis=2)
@@ -219,10 +225,9 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, res, do):
             dv, dv_blk, kb * block_k, axis=2)
         return dq + dq_blk, dk, dv
 
-    dq0 = jnp.zeros_like(qf)
-    dk0 = jnp.zeros_like(kf)
-    dv0 = jnp.zeros_like(vf)
-    dq, dk, dv = jax.lax.fori_loop(0, n_blocks, body, (dq0, dk0, dv0))
+    shape_f32 = lambda t: jnp.zeros(t.shape, jnp.float32)
+    dq, dk, dv = jax.lax.fori_loop(
+        0, n_blocks, body, (shape_f32(q), shape_f32(k), shape_f32(v)))
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -231,7 +236,7 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def flash_attention(q, k, v, causal: bool = True,
                     scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: int = 512, block_k: int = 512):
     """Flash attention. q/k/v: [batch, heads, seq, head_dim].
 
     Pallas kernel on TPU; interpreter mode (same code path) on CPU tests.
