@@ -1,0 +1,585 @@
+// Native control-store daemon — the cluster metadata authority.
+//
+// Reference analog: src/ray/gcs/gcs_server/ (GcsServer hosting the node
+// table + health checker, internal KV, pubsub) and src/ray/pubsub/.  The
+// reference serves these over gRPC; here the wire is a minimal
+// length-prefixed binary protocol over TCP (loopback for single-host,
+// routable for multi-host DCN control traffic).  Payload schemas (node
+// info, published messages) are opaque bytes to the daemon — language
+// frontends pick the encoding, mirroring how the reference's KV stores
+// serialized protobufs it never inspects.
+//
+// Build: part of the `make -C ray_tpu/_native` default target
+// (control_store binary).  Driven from Python by
+// ray_tpu/core/gcs_socket.py.
+//
+// Protocol (all integers little-endian u32 unless noted):
+//   request  := u32 frame_len | u8 op | fields...
+//   response := u32 frame_len | u8 status | fields...
+//   bytes field := u32 len | raw
+//   status: 0 = OK, 1 = ERR (payload = message), 2 = NIL (KV miss)
+//   Subscribed connections additionally receive push frames:
+//     u32 frame_len | u8 0xFE | channel | payload
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t {
+  OP_PING = 1,
+  OP_KV_PUT = 2,
+  OP_KV_GET = 3,
+  OP_KV_DEL = 4,
+  OP_KV_KEYS = 5,
+  OP_NODE_REGISTER = 10,
+  OP_NODE_HEARTBEAT = 11,
+  OP_NODE_LIST = 12,
+  OP_NODE_MARK_DEAD = 13,
+  OP_PUBLISH = 20,
+  OP_SUBSCRIBE = 21,
+  OP_HEALTH_START = 30,
+  OP_STATS = 31,
+  OP_SHUTDOWN = 99,
+  OP_PUSH = 0xFE,
+};
+
+enum Status : uint8_t { ST_OK = 0, ST_ERR = 1, ST_NIL = 2 };
+
+double MonotonicSeconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+// Wire helpers
+// ---------------------------------------------------------------------------
+
+bool ReadAll(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteAll(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class Reader {  // cursor over a received frame
+ public:
+  Reader(const std::vector<char>& buf) : buf_(buf) {}
+  bool U8(uint8_t* v) {
+    if (pos_ + 1 > buf_.size()) return false;
+    *v = static_cast<uint8_t>(buf_[pos_++]);
+    return true;
+  }
+  bool U32(uint32_t* v) {
+    if (pos_ + 4 > buf_.size()) return false;
+    std::memcpy(v, buf_.data() + pos_, 4);
+    pos_ += 4;
+    return true;
+  }
+  bool F64(double* v) {
+    if (pos_ + 8 > buf_.size()) return false;
+    std::memcpy(v, buf_.data() + pos_, 8);
+    pos_ += 8;
+    return true;
+  }
+  bool Bytes(std::string* out) {
+    uint32_t n;
+    if (!U32(&n) || pos_ + n > buf_.size()) return false;
+    out->assign(buf_.data() + pos_, n);
+    pos_ += n;
+    return true;
+  }
+
+ private:
+  const std::vector<char>& buf_;
+  size_t pos_ = 0;
+};
+
+struct Connection {
+  int fd;
+  std::mutex write_mu;  // responses and pushes interleave
+  bool closed = false;  // guarded by write_mu; set before ::close(fd)
+  explicit Connection(int f) : fd(f) {}
+};
+
+class Writer {  // builds a frame body (status/op byte first)
+ public:
+  explicit Writer(uint8_t first) { buf_.push_back(static_cast<char>(first)); }
+  void U8(uint8_t v) { buf_.push_back(static_cast<char>(v)); }
+  void U32(uint32_t v) { Append(&v, 4); }
+  void F64(double v) { Append(&v, 8); }
+  void Bytes(const std::string& s) {
+    U32(static_cast<uint32_t>(s.size()));
+    Append(s.data(), s.size());
+  }
+  bool Send(Connection* conn) {
+    uint32_t len = static_cast<uint32_t>(buf_.size());
+    // Serialized with close: a publish must never write into an fd the
+    // handler already closed (the number could be reused by a new accept).
+    std::lock_guard<std::mutex> lk(conn->write_mu);
+    if (conn->closed) return false;
+    return WriteAll(conn->fd, &len, 4) &&
+           WriteAll(conn->fd, buf_.data(), buf_.size());
+  }
+
+ private:
+  void Append(const void* p, size_t n) {
+    const auto* c = static_cast<const char*>(p);
+    buf_.insert(buf_.end(), c, c + n);
+  }
+  std::vector<char> buf_;
+};
+
+// ---------------------------------------------------------------------------
+// Store state
+// ---------------------------------------------------------------------------
+
+struct NodeEntry {
+  std::string info;  // opaque frontend-encoded payload
+  bool alive = true;
+  double last_heartbeat = 0;
+};
+
+class ControlStore {
+ public:
+  // KV ------------------------------------------------------------------
+  bool KvPut(const std::string& ns, const std::string& key,
+             const std::string& val, bool overwrite) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto& m = kv_[ns];
+    if (!overwrite && m.count(key)) return false;
+    m[key] = val;
+    return true;
+  }
+  bool KvGet(const std::string& ns, const std::string& key,
+             std::string* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = kv_.find(ns);
+    if (it == kv_.end()) return false;
+    auto jt = it->second.find(key);
+    if (jt == it->second.end()) return false;
+    *out = jt->second;
+    return true;
+  }
+  bool KvDel(const std::string& ns, const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = kv_.find(ns);
+    return it != kv_.end() && it->second.erase(key) > 0;
+  }
+  std::vector<std::string> KvKeys(const std::string& ns,
+                                  const std::string& prefix) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<std::string> out;
+    auto it = kv_.find(ns);
+    if (it == kv_.end()) return out;
+    for (const auto& [k, _] : it->second)
+      if (k.rfind(prefix, 0) == 0) out.push_back(k);
+    return out;
+  }
+
+  // Node table -----------------------------------------------------------
+  void NodeRegister(const std::string& id, const std::string& info) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto& e = nodes_[id];
+      e.info = info;
+      e.alive = true;
+      e.last_heartbeat = MonotonicSeconds();
+    }
+    Publish("NODE", "ALIVE:" + id);
+  }
+  void NodeHeartbeat(const std::string& id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = nodes_.find(id);
+    if (it != nodes_.end()) it->second.last_heartbeat = MonotonicSeconds();
+  }
+  bool NodeMarkDead(const std::string& id) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = nodes_.find(id);
+      if (it == nodes_.end() || !it->second.alive) return false;
+      it->second.alive = false;
+    }
+    Publish("NODE", "DEAD:" + id);
+    return true;
+  }
+  std::vector<std::tuple<std::string, bool, double, std::string>> NodeList() {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<std::tuple<std::string, bool, double, std::string>> out;
+    double now = MonotonicSeconds();
+    for (const auto& [id, e] : nodes_)
+      out.emplace_back(id, e.alive, now - e.last_heartbeat, e.info);
+    return out;
+  }
+
+  // Pubsub ---------------------------------------------------------------
+  void Subscribe(const std::string& channel,
+                 std::shared_ptr<Connection> conn) {
+    std::lock_guard<std::mutex> lk(mu_);
+    subs_[channel].push_back(conn);
+  }
+  uint32_t Publish(const std::string& channel, const std::string& payload) {
+    std::vector<std::shared_ptr<Connection>> targets;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = subs_.find(channel);
+      if (it == subs_.end()) return 0;
+      targets = it->second;
+    }
+    uint32_t delivered = 0;
+    std::set<int> dead;
+    for (auto& conn : targets) {
+      Writer push(OP_PUSH);
+      push.Bytes(channel);
+      push.Bytes(payload);
+      if (push.Send(conn.get())) {
+        delivered++;
+      } else {
+        dead.insert(conn->fd);
+      }
+    }
+    if (!dead.empty()) {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (auto& [ch, vec] : subs_) {
+        vec.erase(std::remove_if(vec.begin(), vec.end(),
+                                 [&](const std::shared_ptr<Connection>& c) {
+                                   return dead.count(c->fd) > 0;
+                                 }),
+                  vec.end());
+      }
+    }
+    return delivered;
+  }
+  void DropConnection(int fd) {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& [ch, vec] : subs_)
+      vec.erase(std::remove_if(vec.begin(), vec.end(),
+                               [&](const std::shared_ptr<Connection>& c) {
+                                 return c->fd == fd;
+                               }),
+                vec.end());
+  }
+
+  // Health checker (GcsHeartbeatManager equivalent) ----------------------
+  void StartHealth(double period_s, uint32_t timeout_beats) {
+    std::lock_guard<std::mutex> lk(health_mu_);
+    health_period_ = period_s;
+    health_beats_ = timeout_beats;
+    if (health_running_) return;
+    health_running_ = true;
+    health_thread_ = std::thread([this] { HealthLoop(); });
+  }
+  void HealthLoop() {
+    std::unique_lock<std::mutex> lk(health_mu_);
+    while (!stopping_) {
+      health_cv_.wait_for(lk, std::chrono::duration<double>(health_period_));
+      if (stopping_) break;
+      double deadline = MonotonicSeconds() - health_period_ * health_beats_;
+      std::vector<std::string> expired;
+      {
+        std::lock_guard<std::mutex> slk(mu_);
+        for (const auto& [id, e] : nodes_)
+          if (e.alive && e.last_heartbeat < deadline) expired.push_back(id);
+      }
+      for (const auto& id : expired) NodeMarkDead(id);
+    }
+  }
+
+  void Stats(uint32_t* n_nodes, uint32_t* n_kv, uint32_t* n_subs) {
+    std::lock_guard<std::mutex> lk(mu_);
+    *n_nodes = static_cast<uint32_t>(nodes_.size());
+    uint32_t kv = 0;
+    for (const auto& [_, m] : kv_) kv += static_cast<uint32_t>(m.size());
+    *n_kv = kv;
+    uint32_t s = 0;
+    for (const auto& [_, v] : subs_) s += static_cast<uint32_t>(v.size());
+    *n_subs = s;
+  }
+
+  void Shutdown() {
+    {
+      std::lock_guard<std::mutex> lk(health_mu_);
+      stopping_ = true;
+    }
+    health_cv_.notify_all();
+    if (health_thread_.joinable()) health_thread_.join();
+  }
+
+ private:
+  std::mutex mu_;
+  std::unordered_map<std::string, std::unordered_map<std::string, std::string>>
+      kv_;
+  std::map<std::string, NodeEntry> nodes_;
+  std::unordered_map<std::string, std::vector<std::shared_ptr<Connection>>>
+      subs_;
+
+  std::mutex health_mu_;
+  std::condition_variable health_cv_;
+  std::thread health_thread_;
+  double health_period_ = 1.0;
+  uint32_t health_beats_ = 5;
+  bool health_running_ = false;
+  bool stopping_ = false;
+};
+
+std::atomic<bool> g_shutdown{false};
+std::atomic<int> g_listen_fd{-1};
+
+void HandleConnection(ControlStore* store, std::shared_ptr<Connection> conn) {
+  for (;;) {
+    uint32_t frame_len;
+    if (!ReadAll(conn->fd, &frame_len, 4)) break;
+    if (frame_len > (64u << 20)) break;  // sanity cap: 64 MiB control frames
+    std::vector<char> frame(frame_len);
+    if (!ReadAll(conn->fd, frame.data(), frame_len)) break;
+    Reader r(frame);
+    uint8_t op;
+    if (!r.U8(&op)) break;
+
+    switch (op) {
+      case OP_PING: {
+        Writer w(ST_OK);
+        w.Send(conn.get());
+        break;
+      }
+      case OP_KV_PUT: {
+        std::string ns, key, val;
+        uint8_t overwrite;
+        if (!r.Bytes(&ns) || !r.Bytes(&key) || !r.Bytes(&val) ||
+            !r.U8(&overwrite))
+          goto malformed;
+        Writer w(ST_OK);
+        w.U8(store->KvPut(ns, key, val, overwrite != 0) ? 1 : 0);
+        w.Send(conn.get());
+        break;
+      }
+      case OP_KV_GET: {
+        std::string ns, key, val;
+        if (!r.Bytes(&ns) || !r.Bytes(&key)) goto malformed;
+        if (store->KvGet(ns, key, &val)) {
+          Writer w(ST_OK);
+          w.Bytes(val);
+          w.Send(conn.get());
+        } else {
+          Writer w(ST_NIL);
+          w.Send(conn.get());
+        }
+        break;
+      }
+      case OP_KV_DEL: {
+        std::string ns, key;
+        if (!r.Bytes(&ns) || !r.Bytes(&key)) goto malformed;
+        Writer w(ST_OK);
+        w.U8(store->KvDel(ns, key) ? 1 : 0);
+        w.Send(conn.get());
+        break;
+      }
+      case OP_KV_KEYS: {
+        std::string ns, prefix;
+        if (!r.Bytes(&ns) || !r.Bytes(&prefix)) goto malformed;
+        auto keys = store->KvKeys(ns, prefix);
+        Writer w(ST_OK);
+        w.U32(static_cast<uint32_t>(keys.size()));
+        for (const auto& k : keys) w.Bytes(k);
+        w.Send(conn.get());
+        break;
+      }
+      case OP_NODE_REGISTER: {
+        std::string id, info;
+        if (!r.Bytes(&id) || !r.Bytes(&info)) goto malformed;
+        store->NodeRegister(id, info);
+        Writer w(ST_OK);
+        w.Send(conn.get());
+        break;
+      }
+      case OP_NODE_HEARTBEAT: {
+        std::string id;
+        if (!r.Bytes(&id)) goto malformed;
+        store->NodeHeartbeat(id);
+        Writer w(ST_OK);
+        w.Send(conn.get());
+        break;
+      }
+      case OP_NODE_LIST: {
+        auto nodes = store->NodeList();
+        Writer w(ST_OK);
+        w.U32(static_cast<uint32_t>(nodes.size()));
+        for (const auto& [id, alive, age, info] : nodes) {
+          w.Bytes(id);
+          w.U8(alive ? 1 : 0);
+          w.F64(age);
+          w.Bytes(info);
+        }
+        w.Send(conn.get());
+        break;
+      }
+      case OP_NODE_MARK_DEAD: {
+        std::string id;
+        if (!r.Bytes(&id)) goto malformed;
+        Writer w(ST_OK);
+        w.U8(store->NodeMarkDead(id) ? 1 : 0);
+        w.Send(conn.get());
+        break;
+      }
+      case OP_PUBLISH: {
+        std::string channel, payload;
+        if (!r.Bytes(&channel) || !r.Bytes(&payload)) goto malformed;
+        uint32_t n = store->Publish(channel, payload);
+        Writer w(ST_OK);
+        w.U32(n);
+        w.Send(conn.get());
+        break;
+      }
+      case OP_SUBSCRIBE: {
+        std::string channel;
+        if (!r.Bytes(&channel)) goto malformed;
+        store->Subscribe(channel, conn);
+        Writer w(ST_OK);
+        w.Send(conn.get());
+        break;
+      }
+      case OP_HEALTH_START: {
+        double period;
+        uint32_t beats;
+        if (!r.F64(&period) || !r.U32(&beats)) goto malformed;
+        store->StartHealth(period, beats);
+        Writer w(ST_OK);
+        w.Send(conn.get());
+        break;
+      }
+      case OP_STATS: {
+        uint32_t n_nodes, n_kv, n_subs;
+        store->Stats(&n_nodes, &n_kv, &n_subs);
+        Writer w(ST_OK);
+        w.U32(n_nodes);
+        w.U32(n_kv);
+        w.U32(n_subs);
+        w.Send(conn.get());
+        break;
+      }
+      case OP_SHUTDOWN: {
+        Writer w(ST_OK);
+        w.Send(conn.get());
+        g_shutdown = true;
+        // Kick the accept loop out of its blocking accept().
+        ::shutdown(g_listen_fd.load(), SHUT_RDWR);
+        goto done;
+      }
+      default: {
+        Writer w(ST_ERR);
+        w.Bytes("unknown op");
+        w.Send(conn.get());
+        break;
+      }
+    }
+    continue;
+  malformed : {
+    Writer w(ST_ERR);
+    w.Bytes("malformed frame");
+    w.Send(conn.get());
+    goto done;
+  }
+  }
+done:
+  store->DropConnection(conn->fd);
+  {
+    std::lock_guard<std::mutex> lk(conn->write_mu);
+    conn->closed = true;
+    ::close(conn->fd);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 0;  // 0 = ephemeral; actual port printed to stdout
+  const char* host = "127.0.0.1";
+  for (int i = 1; i < argc - 1; i++) {
+    if (!std::strcmp(argv[i], "--port")) port = std::atoi(argv[i + 1]);
+    if (!std::strcmp(argv[i], "--host")) host = argv[i + 1];
+  }
+  ::signal(SIGPIPE, SIG_IGN);
+
+  int listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd < 0) {
+    std::perror("socket");
+    return 1;
+  }
+  int one = 1;
+  ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  ::inet_pton(AF_INET, host, &addr.sin_addr);
+  if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+      0) {
+    std::perror("bind");
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  if (::listen(listen_fd, 128) < 0) {
+    std::perror("listen");
+    return 1;
+  }
+  g_listen_fd = listen_fd;
+  // Startup handshake: the launcher reads the bound port from stdout.
+  std::printf("CONTROL_STORE_PORT %d\n", ntohs(addr.sin_port));
+  std::fflush(stdout);
+
+  ControlStore store;
+  std::vector<std::thread> workers;
+  while (!g_shutdown) {
+    int fd = ::accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) break;
+    if (g_shutdown) {
+      ::close(fd);
+      break;
+    }
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    auto conn = std::make_shared<Connection>(fd);
+    workers.emplace_back(
+        [&store, conn] { HandleConnection(&store, conn); });
+  }
+  ::close(listen_fd);
+  store.Shutdown();
+  // Daemon exit: worker threads die with the process (detached semantics).
+  for (auto& t : workers) t.detach();
+  return 0;
+}

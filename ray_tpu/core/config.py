@@ -77,7 +77,16 @@ _define("num_heartbeats_timeout", int, 30,
         "(reference: gcs_heartbeat_manager.h).")
 _define("heartbeat_period_ms", int, 100, "Node heartbeat period.")
 _define("task_max_retries", int, 3, "Default retries for failed tasks.")
+_define("memory_monitor_enabled", bool, True,
+        "Enable the node OOM guard (reference: memory_monitor.h).")
+_define("memory_usage_threshold", float, 0.95,
+        "Node memory fraction above which the worker-killing policy fires.")
 _define("actor_max_restarts", int, 0, "Default actor restarts on failure.")
+
+_define("native_control_store", bool, False,
+        "Back the control store's KV/pubsub/node-liveness with the native "
+        "C++ daemon (ray_tpu/_native/control_store.cc) instead of the "
+        "in-process Python tables (reference: external gcs_server process).")
 
 # --- Workers -----------------------------------------------------------------
 _define("num_workers_per_node", int, 0,

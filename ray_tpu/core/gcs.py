@@ -235,6 +235,171 @@ class GlobalControlStore:
             self._health_thread.join(timeout=2)
 
 
+class _NativePubsub(Pubsub):
+    """Pubsub whose fan-out rides the native daemon.
+
+    Messages are pickled on publish and unpickled in the subscriber
+    callback wrapper; frames that fail to unpickle are daemon-internal
+    (e.g. its health checker's ``DEAD:<id>`` notices) and are dropped
+    here — the liveness sync thread consumes those via ``list_nodes``.
+    """
+
+    def __init__(self, client):
+        super().__init__()
+        self._client = client
+        self._channels: Set[str] = set()
+
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> Callable[[], None]:
+        unsub_local = super().subscribe(channel, callback)
+        with self._lock:
+            if channel not in self._channels:
+                self._channels.add(channel)
+                # One daemon subscription per channel; local fan-out.
+                self._client.subscribe(channel,
+                                       lambda payload, ch=channel:
+                                       self._on_push(ch, payload))
+        return unsub_local
+
+    def _on_push(self, channel: str, payload: bytes) -> None:
+        import pickle
+
+        try:
+            message = pickle.loads(payload)
+        except Exception:
+            return
+        with self._lock:
+            subs = list(self._subs.get(channel, ()))
+        for cb in subs:
+            try:
+                cb(message)
+            except Exception:
+                pass
+
+    def publish(self, channel: str, message: Any) -> None:
+        import pickle
+
+        # Rides the daemon; local subscribers receive via _on_push (every
+        # local subscribe also registered a daemon subscription). Publish
+        # is fire-and-forget for callers (worker pump threads, actor state
+        # transitions) — a daemon hiccup degrades to local-only fan-out
+        # rather than raising into paths that never expected I/O errors.
+        try:
+            self._client.publish(channel, pickle.dumps(message))
+        except Exception:
+            super().publish(channel, message)
+
+
+class NativeBackedControlStore(GlobalControlStore):
+    """GlobalControlStore with KV, pubsub fan-out, and node-liveness
+    detection delegated to the native C++ daemon.
+
+    Reference analog: the split between ``gcs_server`` (authoritative
+    C++ process) and the in-worker ``GcsClient``. The Python actor/job
+    tables stay in-process (their FSMs drive Python-side scheduling);
+    node liveness is decided by the daemon's health checker and synced
+    back into the Python node table.
+    """
+
+    def __init__(self):
+        from .gcs_socket import ControlStoreProcess
+
+        super().__init__()
+        self._proc = ControlStoreProcess()
+        self._client = self._proc.client()
+        self.pubsub = _NativePubsub(self._client)
+        self._sync_thread: Optional[threading.Thread] = None
+
+    @property
+    def native_address(self):
+        return self._proc.address
+
+    # -- KV: daemon is the single source of truth -------------------------
+    def kv_put(self, key: bytes, value: bytes, namespace: str = "default",
+               overwrite: bool = True) -> bool:
+        return self._client.kv_put(key, value, namespace, overwrite)
+
+    def kv_get(self, key: bytes, namespace: str = "default") -> Optional[bytes]:
+        return self._client.kv_get(key, namespace)
+
+    def kv_del(self, key: bytes, namespace: str = "default") -> bool:
+        return self._client.kv_del(key, namespace)
+
+    def kv_keys(self, prefix: bytes = b"", namespace: str = "default") -> List[bytes]:
+        return self._client.kv_keys(prefix, namespace)
+
+    # -- node table: dual-write; daemon decides liveness -------------------
+    def register_node(self, info: NodeInfo) -> None:
+        import pickle
+
+        self._client.register_node(
+            info.node_id.binary(),
+            pickle.dumps({"resources": info.resources,
+                          "labels": info.labels,
+                          "topology": info.topology}),
+        )
+        super().register_node(info)
+
+    def heartbeat(self, node_id: NodeID) -> None:
+        super().heartbeat(node_id)
+        self._client.heartbeat(node_id.binary())
+
+    def mark_node_dead(self, node_id: NodeID, reason: str = "") -> None:
+        self._client.mark_node_dead(node_id.binary())
+        super().mark_node_dead(node_id, reason)
+
+    def start_health_check(self, period_s: float, timeout_beats: int) -> None:
+        """Detection runs in the daemon; a sync thread applies its
+        verdicts to the Python node table (which publishes NODE events
+        through the normal path)."""
+        self._client.start_health_check(period_s, timeout_beats)
+
+        def sync_loop():
+            while not self._stop.wait(period_s):
+                try:
+                    native_nodes = self._client.list_nodes()
+                except Exception:
+                    continue  # transient daemon I/O error; keep syncing
+                by_id = {}
+                with self._lock:
+                    for node in self.nodes.values():
+                        by_id[node.node_id.binary()] = node
+                for entry in native_nodes:
+                    node = by_id.get(entry["node_id"])
+                    if node is not None and node.alive and not entry["alive"]:
+                        super(NativeBackedControlStore, self).mark_node_dead(
+                            node.node_id, "heartbeat timeout (native)")
+
+        self._sync_thread = threading.Thread(target=sync_loop, daemon=True,
+                                             name="gcs-native-sync")
+        self._sync_thread.start()
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        if self._sync_thread is not None:
+            self._sync_thread.join(timeout=2)
+        try:
+            self._client.close()
+        finally:
+            self._proc.stop()
+
+
+def make_control_store() -> GlobalControlStore:
+    """Factory honoring the ``native_control_store`` config flag, with
+    fallback to the in-process store when the toolchain is missing."""
+    from .config import config
+
+    if config().native_control_store:
+        try:
+            return NativeBackedControlStore()
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "native_control_store requested but unavailable (%s); "
+                "falling back to the in-process store", e)
+    return GlobalControlStore()
+
+
 class GcsClient:
     """Typed accessor facade (reference: gcs_client/accessor.h).
 

@@ -1,0 +1,359 @@
+"""Socket client + launcher for the native control-store daemon.
+
+Reference analog: ``src/ray/gcs/gcs_client/`` (GcsClient over gRPC) talking
+to the ``gcs_server`` process. Here the daemon is the C++ binary built from
+``ray_tpu/_native/control_store.cc``; this module spawns it, speaks its
+length-prefixed binary protocol, and exposes the same surface as the
+in-process :class:`~ray_tpu.core.gcs.GlobalControlStore` KV/node/pubsub
+methods so either backend can serve :class:`~ray_tpu.core.gcs.GcsClient`
+callers.
+
+Payloads the daemon treats as opaque bytes are pickled Python objects on
+this side (like the reference KV storing serialized protobufs).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import subprocess
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_NATIVE_DIR = os.path.join(os.path.dirname(_HERE), "_native")
+_BINARY = os.path.join(_NATIVE_DIR, "build", "control_store")
+
+# Protocol constants — keep in sync with control_store.cc.
+OP_PING = 1
+OP_KV_PUT = 2
+OP_KV_GET = 3
+OP_KV_DEL = 4
+OP_KV_KEYS = 5
+OP_NODE_REGISTER = 10
+OP_NODE_HEARTBEAT = 11
+OP_NODE_LIST = 12
+OP_NODE_MARK_DEAD = 13
+OP_PUBLISH = 20
+OP_SUBSCRIBE = 21
+OP_HEALTH_START = 30
+OP_STATS = 31
+OP_SHUTDOWN = 99
+OP_PUSH = 0xFE
+
+ST_OK = 0
+ST_ERR = 1
+ST_NIL = 2
+
+
+class ControlStoreError(Exception):
+    pass
+
+
+def _pack_bytes(b: bytes) -> bytes:
+    return struct.pack("<I", len(b)) + b
+
+
+class _FrameReader:
+    def __init__(self, data: bytes):
+        self._d = data
+        self._pos = 0
+
+    def u8(self) -> int:
+        v = self._d[self._pos]
+        self._pos += 1
+        return v
+
+    def u32(self) -> int:
+        (v,) = struct.unpack_from("<I", self._d, self._pos)
+        self._pos += 4
+        return v
+
+    def f64(self) -> float:
+        (v,) = struct.unpack_from("<d", self._d, self._pos)
+        self._pos += 8
+        return v
+
+    def bytes_(self) -> bytes:
+        n = self.u32()
+        v = self._d[self._pos:self._pos + n]
+        self._pos += n
+        return v
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ControlStoreError("connection closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return _recv_exact(sock, n)
+
+
+class ControlStoreClient:
+    """Request/response client (one TCP conn, lock-serialized).
+
+    Subscriptions use a second dedicated connection with a reader thread
+    (:meth:`subscribe`), since push frames interleave with responses.
+    """
+
+    def __init__(self, address: Tuple[str, int], timeout: float = 10.0):
+        self.address = address
+        self._sock = socket.create_connection(address, timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        self._sub_client: Optional["_Subscriber"] = None
+
+    # -- wire -------------------------------------------------------------
+    def _call(self, op: int, body: bytes = b"") -> _FrameReader:
+        frame = bytes([op]) + body
+        with self._lock:
+            self._sock.sendall(struct.pack("<I", len(frame)) + frame)
+            reply = _recv_frame(self._sock)
+        r = _FrameReader(reply)
+        status = r.u8()
+        if status == ST_ERR:
+            raise ControlStoreError(r.bytes_().decode("utf-8", "replace"))
+        r.status = status  # type: ignore[attr-defined]
+        return r
+
+    # -- KV ---------------------------------------------------------------
+    def kv_put(self, key: bytes, value: bytes, namespace: str = "default",
+               overwrite: bool = True) -> bool:
+        r = self._call(OP_KV_PUT, _pack_bytes(namespace.encode()) +
+                       _pack_bytes(key) + _pack_bytes(value) +
+                       bytes([1 if overwrite else 0]))
+        return r.u8() == 1
+
+    def kv_get(self, key: bytes, namespace: str = "default"
+               ) -> Optional[bytes]:
+        r = self._call(OP_KV_GET, _pack_bytes(namespace.encode()) +
+                       _pack_bytes(key))
+        if r.status == ST_NIL:  # type: ignore[attr-defined]
+            return None
+        return r.bytes_()
+
+    def kv_del(self, key: bytes, namespace: str = "default") -> bool:
+        r = self._call(OP_KV_DEL, _pack_bytes(namespace.encode()) +
+                       _pack_bytes(key))
+        return r.u8() == 1
+
+    def kv_keys(self, prefix: bytes = b"", namespace: str = "default"
+                ) -> List[bytes]:
+        r = self._call(OP_KV_KEYS, _pack_bytes(namespace.encode()) +
+                       _pack_bytes(prefix))
+        return [r.bytes_() for _ in range(r.u32())]
+
+    # -- node table -------------------------------------------------------
+    def register_node(self, node_id: bytes, info: bytes = b"") -> None:
+        self._call(OP_NODE_REGISTER, _pack_bytes(node_id) +
+                   _pack_bytes(info))
+
+    def heartbeat(self, node_id: bytes) -> None:
+        self._call(OP_NODE_HEARTBEAT, _pack_bytes(node_id))
+
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        r = self._call(OP_NODE_LIST)
+        out = []
+        for _ in range(r.u32()):
+            node_id = r.bytes_()
+            alive = r.u8() == 1
+            age = r.f64()
+            info = r.bytes_()
+            out.append({"node_id": node_id, "alive": alive,
+                        "heartbeat_age_s": age, "info": info})
+        return out
+
+    def mark_node_dead(self, node_id: bytes) -> bool:
+        r = self._call(OP_NODE_MARK_DEAD, _pack_bytes(node_id))
+        return r.u8() == 1
+
+    # -- pubsub -----------------------------------------------------------
+    def publish(self, channel: str, payload: bytes) -> int:
+        r = self._call(OP_PUBLISH, _pack_bytes(channel.encode()) +
+                       _pack_bytes(payload))
+        return r.u32()
+
+    def subscribe(self, channel: str,
+                  callback: Callable[[bytes], None]) -> Callable[[], None]:
+        """Push-based subscription on a dedicated connection."""
+        if self._sub_client is None:
+            self._sub_client = _Subscriber(self.address)
+        return self._sub_client.subscribe(channel, callback)
+
+    # -- control ----------------------------------------------------------
+    def start_health_check(self, period_s: float, timeout_beats: int) -> None:
+        self._call(OP_HEALTH_START, struct.pack("<d", period_s) +
+                   struct.pack("<I", timeout_beats))
+
+    def stats(self) -> Dict[str, int]:
+        r = self._call(OP_STATS)
+        return {"nodes": r.u32(), "kv_entries": r.u32(),
+                "subscriber_channels": r.u32()}
+
+    def ping(self) -> bool:
+        self._call(OP_PING)
+        return True
+
+    def shutdown_server(self) -> None:
+        try:
+            self._call(OP_SHUTDOWN)
+        except ControlStoreError:
+            pass
+
+    def close(self) -> None:
+        if self._sub_client is not None:
+            self._sub_client.close()
+            self._sub_client = None
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _Subscriber:
+    """Dedicated subscription connection + reader thread."""
+
+    def __init__(self, address: Tuple[str, int]):
+        import queue
+
+        self._sock = socket.create_connection(address, timeout=10.0)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        self._callbacks: Dict[str, List[Callable[[bytes], None]]] = {}
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._acks: "queue.Queue[int]" = queue.Queue()
+
+    def subscribe(self, channel: str,
+                  callback: Callable[[bytes], None]) -> Callable[[], None]:
+        import queue
+
+        with self._lock:
+            first_for_channel = channel not in self._callbacks
+            self._callbacks.setdefault(channel, []).append(callback)
+        if first_for_channel:
+            frame = (bytes([OP_SUBSCRIBE]) +
+                     _pack_bytes(channel.encode()))
+            self._sock.sendall(struct.pack("<I", len(frame)) + frame)
+            # Wait for the daemon's ack before returning — a publish
+            # issued right after subscribe() must observe the
+            # subscription (the ack is read inline before the reader
+            # thread exists, via the ack queue afterwards).
+            if self._thread is None:
+                reply = _recv_frame(self._sock)
+                if reply[0] != ST_OK:
+                    raise ControlStoreError("subscribe failed")
+                self._thread = threading.Thread(
+                    target=self._read_loop, daemon=True,
+                    name="control-store-sub")
+                self._thread.start()
+            else:
+                try:
+                    status = self._acks.get(timeout=10.0)
+                except queue.Empty:
+                    raise ControlStoreError("subscribe ack timeout")
+                if status != ST_OK:
+                    raise ControlStoreError("subscribe failed")
+
+        def unsubscribe():
+            with self._lock:
+                try:
+                    self._callbacks.get(channel, []).remove(callback)
+                except ValueError:
+                    pass
+
+        return unsubscribe
+
+    def _read_loop(self) -> None:
+        while not self._closed:
+            try:
+                frame = _recv_frame(self._sock)
+            except (ControlStoreError, OSError):
+                return
+            r = _FrameReader(frame)
+            kind = r.u8()
+            if kind != OP_PUSH:
+                self._acks.put(kind)  # ack for a later SUBSCRIBE
+                continue
+            channel = r.bytes_().decode()
+            payload = r.bytes_()
+            with self._lock:
+                cbs = list(self._callbacks.get(channel, ()))
+            for cb in cbs:
+                try:
+                    cb(payload)
+                except Exception:
+                    pass
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def build_native() -> bool:
+    """Build the daemon binary if missing; True when available."""
+    if os.path.exists(_BINARY):
+        return True
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=180)
+    except Exception:
+        return False
+    return os.path.exists(_BINARY)
+
+
+class ControlStoreProcess:
+    """Owns a spawned daemon (start, port handshake, stop)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        if not build_native():
+            raise ControlStoreError(
+                "control_store binary unavailable (g++/make missing?)")
+        self._proc = subprocess.Popen(
+            [_BINARY, "--port", str(port), "--host", host],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        line = self._proc.stdout.readline()
+        if not line.startswith("CONTROL_STORE_PORT "):
+            self._proc.kill()
+            raise ControlStoreError(f"bad startup handshake: {line!r}")
+        self.port = int(line.split()[1])
+        self.host = host
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def client(self) -> ControlStoreClient:
+        return ControlStoreClient(self.address)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        if self._proc.poll() is None:
+            try:
+                ControlStoreClient(self.address).shutdown_server()
+            except Exception:
+                pass
+            try:
+                self._proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait(timeout=timeout)
+
+    def __del__(self):
+        try:
+            if self._proc.poll() is None:
+                self._proc.kill()
+        except Exception:
+            pass

@@ -1,0 +1,125 @@
+"""Node OOM guard: cgroup/proc memory sampling + kill policy hook.
+
+Reference analog: ``src/ray/common/memory_monitor.h:48`` (MemoryMonitor
+polls cgroup/proc usage on a timer and invokes a callback above a
+usage threshold) and the raylet's worker-killing policy that prefers the
+most-recently-started retriable task, keeping the node alive at the cost
+of one task instead of letting the kernel OOM-killer take the whole
+process tree.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+# cgroup v2 (unified) and v1 paths, tried in order.
+_CGROUP_PATHS = (
+    ("/sys/fs/cgroup/memory.current", "/sys/fs/cgroup/memory.max"),
+    ("/sys/fs/cgroup/memory/memory.usage_in_bytes",
+     "/sys/fs/cgroup/memory/memory.limit_in_bytes"),
+)
+# Limits above this are "no limit" sentinels (cgroup v1 uses PAGE_COUNTER_MAX).
+_LIMIT_CAP = 1 << 60
+
+
+@dataclass
+class MemorySnapshot:
+    used_bytes: int
+    total_bytes: int
+
+    @property
+    def fraction(self) -> float:
+        return self.used_bytes / self.total_bytes if self.total_bytes else 0.0
+
+
+def _read_int(path: str) -> Optional[int]:
+    try:
+        with open(path) as f:
+            raw = f.read().strip()
+        if raw == "max":  # cgroup v2 unlimited
+            return None
+        return int(raw)
+    except (OSError, ValueError):
+        return None
+
+
+def _proc_meminfo() -> Tuple[int, int]:
+    total = avail = 0
+    with open("/proc/meminfo") as f:
+        for line in f:
+            if line.startswith("MemTotal:"):
+                total = int(line.split()[1]) * 1024
+            elif line.startswith("MemAvailable:"):
+                avail = int(line.split()[1]) * 1024
+    return total - avail, total
+
+
+def sample_memory() -> MemorySnapshot:
+    """Cgroup limits win over host totals when the process is contained."""
+    host_used, host_total = _proc_meminfo()
+    for usage_path, limit_path in _CGROUP_PATHS:
+        usage = _read_int(usage_path)
+        limit = _read_int(limit_path)
+        if usage is not None and limit is not None and limit < _LIMIT_CAP:
+            return MemorySnapshot(usage, min(limit, host_total or limit))
+    return MemorySnapshot(host_used, host_total)
+
+
+class MemoryMonitor:
+    """Polls memory and fires ``on_high(snapshot)`` above the threshold.
+
+    The callback decides the policy (the raylet equivalent kills the
+    newest retriable task); the monitor only detects, with a refractory
+    period so one pressure episode doesn't fire a kill storm.
+    """
+
+    def __init__(self, threshold: float = 0.95,
+                 period_s: float = 1.0,
+                 on_high: Optional[Callable[[MemorySnapshot], None]] = None,
+                 min_callback_interval_s: float = 5.0):
+        self.threshold = threshold
+        self.period_s = period_s
+        self.on_high = on_high
+        self.min_callback_interval_s = min_callback_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_fired = 0.0
+        self.last_snapshot: Optional[MemorySnapshot] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()  # allow stop() -> start() restart
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rt-memory-monitor")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period_s):
+            self.poll_once()
+
+    def poll_once(self) -> Optional[MemorySnapshot]:
+        try:
+            snap = sample_memory()
+        except OSError:
+            return None
+        self.last_snapshot = snap
+        if (snap.fraction >= self.threshold and self.on_high is not None
+                and time.monotonic() - self._last_fired
+                >= self.min_callback_interval_s):
+            self._last_fired = time.monotonic()
+            try:
+                self.on_high(snap)
+            except Exception:
+                pass
+        return snap
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

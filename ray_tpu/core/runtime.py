@@ -102,7 +102,9 @@ class Runtime:
                  env: Optional[dict] = None):
         self.job_id = JobID.next()
         self.driver_task_id = TaskID.for_driver(self.job_id)
-        self.gcs = GlobalControlStore()
+        from .gcs import make_control_store
+
+        self.gcs = make_control_store()
         self.gcs_client = GcsClient(self.gcs)
         self.scheduler = ClusterScheduler(self.gcs)
         self.serializer = Serializer(ref_class=ObjectRef)
@@ -147,11 +149,28 @@ class Runtime:
             while not self._hb_stop.wait(period):
                 for node in self.scheduler.nodes():
                     if node.alive:
-                        self.gcs.heartbeat(node.node_id)
+                        try:
+                            self.gcs.heartbeat(node.node_id)
+                        except Exception:
+                            # Native backend does TCP I/O; one timeout must
+                            # not kill the loop (a dead loop -> every node
+                            # eventually marked dead by the health checker).
+                            pass
 
         self._hb_thread = threading.Thread(target=_heartbeats, daemon=True,
                                            name="rt-heartbeats")
         self._hb_thread.start()
+        # Node OOM guard (reference: MemoryMonitor + raylet worker-killing
+        # policy — kill the newest retriable task instead of letting the
+        # kernel OOM-killer take the node).
+        from .memory_monitor import MemoryMonitor
+
+        self.memory_monitor = MemoryMonitor(
+            threshold=config().memory_usage_threshold,
+            on_high=self._on_memory_pressure,
+        )
+        if config().memory_monitor_enabled:
+            self.memory_monitor.start()
         install_refcount_hooks(
             add=self._ref_added, remove=self._ref_removed, borrow=self._ref_added
         )
@@ -987,6 +1006,48 @@ class Runtime:
             if node is not None:
                 node.pool.size = max(1, node.pool.size - 1)
 
+    # --------------------------------------------------- memory pressure
+    def _on_memory_pressure(self, snapshot) -> None:
+        """Worker-killing policy: above the usage threshold, kill the
+        worker running the newest retriable normal task (reference:
+        raylet worker killing policy — newest-first protects long-running
+        work, retriable-first guarantees forward progress)."""
+        victim = None
+        with self._lock:
+            for worker_bin in reversed(list(self._worker_tasks)):
+                task_id = self._worker_tasks[worker_bin]
+                record = self._tasks.get(task_id)
+                if (record is not None and record.state == "RUNNING"
+                        and record.worker is not None
+                        and record.worker.actor_id is None
+                        and record.retries_left > 0):
+                    victim = record
+                    # Mark DEAD while still holding the lock: a worker that
+                    # finishes the victim task in the kill window must not
+                    # be re-leased to an innocent (maybe non-retriable)
+                    # task — pop_idle skips DEAD handles.
+                    from .worker_pool import WorkerHandle
+
+                    victim.worker.state = WorkerHandle.DEAD
+                    break
+        if victim is None:
+            return
+        try:
+            from ..observability.events import emit
+
+            emit("MEMORY_PRESSURE",
+                 f"killing task {victim.spec.describe()} at "
+                 f"{snapshot.fraction:.0%} node memory")
+        except Exception:
+            pass
+        worker = victim.worker
+        worker.kill()
+        # kill() marks the handle DEAD before the process exits, which
+        # tells the pool's handler loop NOT to fire on_worker_death (so
+        # intentional kills — rt.kill, shutdown — stay silent). This kill
+        # wants the failure path: invoke it directly to fail-and-retry.
+        self._handle_worker_death(worker)
+
     # ------------------------------------------------------- worker death
     def _handle_worker_death(self, worker: WorkerHandle) -> None:
         with self._lock:
@@ -1089,6 +1150,7 @@ class Runtime:
         self.gcs.finish_job(self.job_id)
         install_refcount_hooks()
         self._hb_stop.set()
+        self.memory_monitor.stop()
         self.scheduler.shutdown()
         self.gcs.shutdown()
 

@@ -1,0 +1,138 @@
+"""Native control-store daemon tests: KV, node table + health, pubsub.
+
+Reference coverage analog: gcs_server unit tests
+(``src/ray/gcs/gcs_server/test/``) exercised over the real socket
+protocol, like ``gcs_server_rpc_test.cc``.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.core.gcs_socket import (
+    ControlStoreClient,
+    ControlStoreError,
+    ControlStoreProcess,
+    build_native,
+)
+
+pytestmark = pytest.mark.skipif(
+    not build_native(), reason="native toolchain unavailable")
+
+
+@pytest.fixture()
+def store():
+    proc = ControlStoreProcess()
+    client = proc.client()
+    yield client
+    client.close()
+    proc.stop()
+
+
+def test_ping_and_stats(store):
+    assert store.ping()
+    s = store.stats()
+    assert s == {"nodes": 0, "kv_entries": 0, "subscriber_channels": 0}
+
+
+def test_kv_roundtrip(store):
+    assert store.kv_get(b"missing") is None
+    assert store.kv_put(b"k", b"v1")
+    assert store.kv_get(b"k") == b"v1"
+    # no-overwrite put is rejected
+    assert not store.kv_put(b"k", b"v2", overwrite=False)
+    assert store.kv_get(b"k") == b"v1"
+    # namespaces are disjoint
+    assert store.kv_get(b"k", namespace="other") is None
+    store.kv_put(b"k2", b"x")
+    store.kv_put(b"j1", b"y")
+    assert sorted(store.kv_keys(b"k")) == [b"k", b"k2"]
+    assert store.kv_del(b"k")
+    assert store.kv_get(b"k") is None
+    assert not store.kv_del(b"k")
+
+
+def test_kv_large_value(store):
+    blob = bytes(range(256)) * 4096  # 1 MiB
+    store.kv_put(b"big", blob)
+    assert store.kv_get(b"big") == blob
+
+
+def test_node_lifecycle_and_health(store):
+    store.register_node(b"node-1", b"info-1")
+    store.register_node(b"node-2", b"info-2")
+    nodes = {n["node_id"]: n for n in store.list_nodes()}
+    assert nodes[b"node-1"]["alive"] and nodes[b"node-2"]["alive"]
+    assert nodes[b"node-1"]["info"] == b"info-1"
+
+    events = []
+    store.subscribe("NODE", events.append)
+    time.sleep(0.05)
+
+    # node-2 stops heartbeating; health checker marks it dead.
+    store.start_health_check(0.05, 2)
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline:
+        store.heartbeat(b"node-1")
+        nodes = {n["node_id"]: n for n in store.list_nodes()}
+        if not nodes[b"node-2"]["alive"]:
+            break
+        time.sleep(0.02)
+    nodes = {n["node_id"]: n for n in store.list_nodes()}
+    assert nodes[b"node-1"]["alive"], "heartbeating node must stay alive"
+    assert not nodes[b"node-2"]["alive"], "silent node must be marked dead"
+    time.sleep(0.05)
+    assert b"DEAD:node-2" in events
+
+
+def test_pubsub_fanout(store):
+    got_a, got_b = [], []
+    unsub_a = store.subscribe("chan", got_a.append)
+    store.subscribe("chan", got_b.append)
+    time.sleep(0.05)
+    n = store.publish("chan", b"hello")
+    assert n == 1  # one subscriber *connection* (fan-out client-side)
+    deadline = time.monotonic() + 2.0
+    while (not got_a or not got_b) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert got_a == [b"hello"] and got_b == [b"hello"]
+
+    unsub_a()
+    store.publish("chan", b"again")
+    time.sleep(0.2)
+    assert got_a == [b"hello"]  # unsubscribed callback silent
+    assert got_b == [b"hello", b"again"]
+
+
+def test_publish_without_subscribers(store):
+    assert store.publish("empty-channel", b"x") == 0
+
+
+def test_multiple_clients_share_state(store):
+    second = ControlStoreClient(store.address)
+    try:
+        store.kv_put(b"shared", b"value")
+        assert second.kv_get(b"shared") == b"value"
+        # Cross-client pubsub: publish from one, receive on the other.
+        got = []
+        second.subscribe("x", got.append)
+        time.sleep(0.05)
+        store.publish("x", b"cross")
+        deadline = time.monotonic() + 2.0
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert got == [b"cross"]
+    finally:
+        second.close()
+
+
+def test_server_shutdown_via_protocol():
+    proc = ControlStoreProcess()
+    client = proc.client()
+    client.kv_put(b"k", b"v")
+    client.shutdown_server()
+    deadline = time.monotonic() + 5.0
+    while proc._proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert proc._proc.poll() is not None, "daemon must exit on SHUTDOWN"
+    client.close()
