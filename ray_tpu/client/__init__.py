@@ -1,0 +1,29 @@
+"""Remote-driver client ("Ray Client" equivalent).
+
+Reference analog: ``python/ray/util/client/`` — a gRPC proxy that lets a
+remote Python process drive a running cluster as if it were the driver
+(``ray.init("ray://host:port")``); the server multiplexes many clients
+onto the head runtime (``util/client/server/server.py``, architecture doc
+``util/client/ARCHITECTURE.md``).
+
+Here the wire is the same length-prefixed frame protocol as the native
+control store; payloads are cloudpickle. Usage::
+
+    # cluster side
+    from ray_tpu.client import serve_forever  # or ClientServer
+    server = ClientServer(runtime_already_initialized=True); server.start()
+
+    # client side
+    import ray_tpu.client as client
+    session = client.connect("127.0.0.1:10001")
+    ref = session.remote(lambda x: x + 1)(41)
+    assert session.get(ref) == 42
+"""
+
+from .client import ClientActorHandle, ClientObjectRef, ClientSession, connect
+from .server import ClientServer
+
+__all__ = [
+    "ClientActorHandle", "ClientObjectRef", "ClientServer", "ClientSession",
+    "connect",
+]

@@ -1,0 +1,204 @@
+"""Client-side session for driving a remote cluster.
+
+Reference analog: ``python/ray/util/client/worker.py`` (the Worker that
+proxies ``ray.*`` calls over the wire) and ``common.py``
+(ClientObjectRef/ClientActorHandle/ClientRemoteFunc).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import uuid
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import cloudpickle
+
+from .server import recv_msg, send_msg
+
+
+class ClientError(Exception):
+    pass
+
+
+class ClientObjectRef:
+    """Opaque handle to a server-side ObjectRef."""
+
+    def __init__(self, hex_id: str, session: "ClientSession"):
+        self._hex = hex_id
+        self._session = session
+
+    def hex(self) -> str:
+        return self._hex
+
+    def _wire(self) -> dict:
+        return {"__client_ref__": True, "hex": self._hex}
+
+    def __repr__(self):
+        return f"ClientObjectRef({self._hex[:12]})"
+
+
+class ClientRemoteFunction:
+    def __init__(self, session: "ClientSession", fn, options: dict):
+        self._session = session
+        self._fn_id = uuid.uuid4().hex
+        self._registered = False
+        self._fn = fn
+        self._options = options
+
+    def _ensure_registered(self) -> None:
+        if not self._registered:
+            self._session._call({
+                "op": "register_fn", "fn_id": self._fn_id,
+                "fn": cloudpickle.dumps(self._fn),
+                "options": self._options,
+            })
+            self._registered = True
+
+    def remote(self, *args, **kwargs) -> ClientObjectRef:
+        self._ensure_registered()
+        reply = self._session._call({
+            "op": "task", "fn_id": self._fn_id,
+            "args": self._session._wire_args(args),
+            "kwargs": self._session._wire_kwargs(kwargs),
+        })
+        return ClientObjectRef(reply["ref"], self._session)
+
+    def options(self, **opts) -> "ClientRemoteFunction":
+        merged = dict(self._options)
+        merged.update(opts)
+        return ClientRemoteFunction(self._session, self._fn, merged)
+
+
+class ClientActorMethod:
+    def __init__(self, handle: "ClientActorHandle", name: str):
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args, **kwargs) -> ClientObjectRef:
+        session = self._handle._session
+        reply = session._call({
+            "op": "actor_method", "actor_id": self._handle._actor_id,
+            "method": self._name,
+            "args": session._wire_args(args),
+            "kwargs": session._wire_kwargs(kwargs),
+        })
+        return ClientObjectRef(reply["ref"], session)
+
+
+class ClientActorHandle:
+    def __init__(self, session: "ClientSession", actor_id: str):
+        self._session = session
+        self._actor_id = actor_id
+
+    def __getattr__(self, name: str) -> ClientActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ClientActorMethod(self, name)
+
+
+class ClientActorClass:
+    def __init__(self, session: "ClientSession", cls, options: dict):
+        self._session = session
+        self._cls = cls
+        self._options = options
+
+    def remote(self, *args, **kwargs) -> ClientActorHandle:
+        reply = self._session._call({
+            "op": "actor_create", "cls": cloudpickle.dumps(self._cls),
+            "options": self._options,
+            "args": self._session._wire_args(args),
+            "kwargs": self._session._wire_kwargs(kwargs),
+        })
+        return ClientActorHandle(self._session, reply["actor_id"])
+
+    def options(self, **opts) -> "ClientActorClass":
+        merged = dict(self._options)
+        merged.update(opts)
+        return ClientActorClass(self._session, self._cls, merged)
+
+
+class ClientSession:
+    """One connection to a ClientServer; thread-safe request/response."""
+
+    def __init__(self, address: Union[str, Tuple[str, int]],
+                 timeout: float = 30.0):
+        if isinstance(address, str):
+            host, _, port = address.partition(":")
+            address = (host, int(port))
+        self._sock = socket.create_connection(address, timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        self._call({"op": "ping"})
+
+    # -- wire ------------------------------------------------------------
+    def _call(self, req: dict) -> dict:
+        with self._lock:
+            send_msg(self._sock, req)
+            reply = recv_msg(self._sock)
+        if "error" in reply:
+            raise reply["error"]
+        return reply
+
+    def _wire_args(self, args: Sequence[Any]) -> list:
+        return [a._wire() if isinstance(a, ClientObjectRef) else a
+                for a in args]
+
+    def _wire_kwargs(self, kwargs: dict) -> dict:
+        return {k: (v._wire() if isinstance(v, ClientObjectRef) else v)
+                for k, v in kwargs.items()}
+
+    # -- API mirror ------------------------------------------------------
+    def put(self, value: Any) -> ClientObjectRef:
+        reply = self._call({"op": "put", "value": value})
+        return ClientObjectRef(reply["ref"], self)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ClientObjectRef)
+        if single:
+            refs = [refs]
+        reply = self._call({"op": "get", "refs": [r.hex() for r in refs],
+                            "timeout": timeout})
+        values = reply["values"]
+        return values[0] if single else values
+
+    def wait(self, refs: List[ClientObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None):
+        reply = self._call({"op": "wait",
+                            "refs": [r.hex() for r in refs],
+                            "num_returns": num_returns,
+                            "timeout": timeout})
+        by_hex = {r.hex(): r for r in refs}
+        return ([by_hex[h] for h in reply["ready"]],
+                [by_hex[h] for h in reply["pending"]])
+
+    def remote(self, fn_or_class=None, **options):
+        """Mirror of ``rt.remote``: decorator for functions and classes."""
+        def wrap(target):
+            if isinstance(target, type):
+                return ClientActorClass(self, target, options)
+            return ClientRemoteFunction(self, target, options)
+
+        if fn_or_class is None:
+            return wrap
+        return wrap(fn_or_class)
+
+    def kill(self, actor: ClientActorHandle) -> None:
+        self._call({"op": "kill_actor", "actor_id": actor._actor_id})
+
+    def release(self, refs: List[ClientObjectRef]) -> None:
+        self._call({"op": "release", "refs": [r.hex() for r in refs]})
+
+    def cluster_info(self) -> dict:
+        return self._call({"op": "cluster_info"})
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def connect(address: Union[str, Tuple[str, int]], **kwargs) -> ClientSession:
+    """Reference: ``ray.init("ray://host:port")`` / ``ray.util.connect``."""
+    return ClientSession(address, **kwargs)

@@ -1,0 +1,186 @@
+"""Client server: hosts remote drivers against the local runtime.
+
+Reference analog: ``python/ray/util/client/server/server.py`` — the
+RayletServicer holding per-client object/actor maps, translating proxied
+calls into real core API calls; started by ``ray start`` as the "ray
+client server" on port 10001.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import traceback
+from typing import Any, Dict, Optional, Tuple
+
+import cloudpickle
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("client connection closed")
+        buf += chunk
+    return buf
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return cloudpickle.loads(_recv_exact(sock, n))
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = cloudpickle.dumps(obj)
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+class _ClientState:
+    """Per-connection object/actor registries (reference: per-client
+    state in RayletServicer; refs are released when the client drops)."""
+
+    def __init__(self):
+        self.object_refs: Dict[str, Any] = {}
+        self.actor_handles: Dict[str, Any] = {}
+        self.remote_fns: Dict[str, Any] = {}
+
+
+class ClientServer:
+    """Serves remote drivers over TCP; one thread per connection."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 init_kwargs: Optional[dict] = None):
+        import ray_tpu as rt
+
+        self._rt = rt
+        if not rt.is_initialized():
+            rt.init(**(init_kwargs or {}))
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(16)
+        self.address: Tuple[str, int] = self._listener.getsockname()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="rt-client-server")
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_one, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_one(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        state = _ClientState()
+        rt = self._rt
+        try:
+            while True:
+                req = recv_msg(conn)
+                try:
+                    reply = self._dispatch(rt, state, req)
+                except Exception as e:  # error travels to the client
+                    reply = {"error": e,
+                             "traceback": traceback.format_exc()}
+                send_msg(conn, reply)
+        except (ConnectionError, OSError, EOFError):
+            pass
+        finally:
+            # Release this client's refs (reference: client disconnect
+            # releases all per-client object/actor references).
+            state.object_refs.clear()
+            for handle in state.actor_handles.values():
+                try:
+                    rt.kill(handle)
+                except Exception:
+                    pass
+            conn.close()
+
+    def _dispatch(self, rt, state: _ClientState, req: dict) -> dict:
+        op = req["op"]
+        if op == "ping":
+            return {"ok": True}
+        if op == "put":
+            ref = rt.put(req["value"])
+            state.object_refs[ref.hex()] = ref
+            return {"ref": ref.hex()}
+        if op == "get":
+            refs = [state.object_refs[h] for h in req["refs"]]
+            out = rt.get(refs, timeout=req.get("timeout"))
+            return {"values": out}
+        if op == "wait":
+            refs = [state.object_refs[h] for h in req["refs"]]
+            ready, pending = rt.wait(
+                refs, num_returns=req.get("num_returns", 1),
+                timeout=req.get("timeout"))
+            return {"ready": [r.hex() for r in ready],
+                    "pending": [r.hex() for r in pending]}
+        if op == "register_fn":
+            fn = cloudpickle.loads(req["fn"])
+            options = req.get("options") or {}
+            remote_fn = rt.remote(**options)(fn) if options else rt.remote(fn)
+            state.remote_fns[req["fn_id"]] = remote_fn
+            return {"ok": True}
+        if op == "task":
+            remote_fn = state.remote_fns[req["fn_id"]]
+            args, kwargs = self._resolve_args(state, req)
+            ref = remote_fn.remote(*args, **kwargs)
+            state.object_refs[ref.hex()] = ref
+            return {"ref": ref.hex()}
+        if op == "actor_create":
+            cls = cloudpickle.loads(req["cls"])
+            options = req.get("options") or {}
+            remote_cls = (rt.remote(**options)(cls) if options
+                          else rt.remote(cls))
+            args, kwargs = self._resolve_args(state, req)
+            handle = remote_cls.remote(*args, **kwargs)
+            actor_key = handle._actor_id.hex()
+            state.actor_handles[actor_key] = handle
+            return {"actor_id": actor_key}
+        if op == "actor_method":
+            handle = state.actor_handles[req["actor_id"]]
+            args, kwargs = self._resolve_args(state, req)
+            ref = getattr(handle, req["method"]).remote(*args, **kwargs)
+            state.object_refs[ref.hex()] = ref
+            return {"ref": ref.hex()}
+        if op == "kill_actor":
+            handle = state.actor_handles.pop(req["actor_id"], None)
+            if handle is not None:
+                rt.kill(handle)
+            return {"ok": True}
+        if op == "release":
+            for h in req["refs"]:
+                state.object_refs.pop(h, None)
+            return {"ok": True}
+        if op == "cluster_info":
+            return {"nodes": len(rt.nodes()),
+                    "resources": rt.cluster_resources()}
+        raise ValueError(f"unknown op {op!r}")
+
+    def _resolve_args(self, state: _ClientState, req: dict):
+        """Client-side ObjectRef placeholders -> server-side refs."""
+
+        def resolve(v):
+            if isinstance(v, dict) and v.get("__client_ref__"):
+                return state.object_refs[v["hex"]]
+            return v
+
+        args = [resolve(a) for a in req.get("args", ())]
+        kwargs = {k: resolve(v) for k, v in req.get("kwargs", {}).items()}
+        return args, kwargs
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
