@@ -51,7 +51,7 @@ def main():
         dtype=jnp.bfloat16,
         attention_impl="flash" if on_tpu else "reference",
         remat=True,
-        remat_policy=os.environ.get("BENCH_REMAT", "dots"),
+        remat_policy=os.environ.get("BENCH_REMAT", "dots_attn"),
     )
 
     mesh = MeshSpec(dp=n_dev).build()

@@ -212,7 +212,8 @@ def forward(params, tokens, cfg: GPT2Config, rules=None):
         elif cfg.remat_policy == "dots_attn":
             policy = jax.checkpoint_policies.save_from_both_policies(
                 jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                jax.checkpoint_policies.save_only_these_names("attn_out"),
+                jax.checkpoint_policies.save_only_these_names(
+                    "attn_out", "attn_lse"),
             )
             block = jax.checkpoint(block, policy=policy)
         else:

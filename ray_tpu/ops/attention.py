@@ -165,6 +165,163 @@ def _flash_fwd_pallas(q, k, v, causal: bool, scale: float,
 
 
 # ---------------------------------------------------------------------------
+# Pallas backward kernels: dq (grid over Q blocks) + dk/dv (grid over K
+# blocks). P/dS tiles live in VMEM — the XLA-recompute fallback materializes
+# them to HBM, which dominates attention cost at training shapes.
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, seq_k: int, scale: float,
+                         causal: bool, block_q: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0]            # [bq, d] input dtype
+    do = do_ref[0]          # [bq, d]
+    lse = lse_ref[0]        # [bq, 1] fp32
+    delta = delta_ref[0]    # [bq, 1] fp32
+    d = q.shape[-1]
+
+    num_kb = seq_k // block_k
+    if causal:
+        upper = jnp.minimum(
+            num_kb, (qi + 1) * block_q // block_k + (block_q // block_k == 0)
+        )
+        upper = jnp.maximum(upper, 1)
+    else:
+        upper = num_kb
+
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, dq_acc):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = (jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1) + kb * block_k)
+            s = jnp.where(q_pos + qi * block_q >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        return dq_acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, upper, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, seq_q: int,
+                          scale: float, causal: bool, block_k: int):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    k = k_ref[0]  # [bk, d]
+    v = v_ref[0]  # [bk, d]
+    d = k.shape[-1]
+
+    num_qb = seq_q // block_q
+    if causal:
+        # Only Q blocks at or after this K block's diagonal contribute.
+        lower = jnp.maximum(0, (ki * block_k) // block_q)
+    else:
+        lower = 0
+
+    k_pos = (jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+             + ki * block_k)
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :]
+        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q), :]
+        delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q), :]
+        s = jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = (jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + qb * block_q)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_blk)  # [bq, bk] fp32
+        p_lo = p.astype(do_blk.dtype)
+        dv_new = dv_acc + jax.lax.dot_general(
+            p_lo, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_blk) * scale).astype(q_blk.dtype)
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(
+        lower, num_qb, body,
+        (jnp.zeros((block_k, d), jnp.float32),
+         jnp.zeros((block_k, d), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale,
+                      block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, sq, d)
+    k3 = k.reshape(bh, sk, d)
+    v3 = v.reshape(bh, sk, d)
+    do3 = do.reshape(bh, sq, d)
+    lse3 = lse.reshape(bh, sq, 1)
+    delta3 = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                     axis=-1).reshape(bh, sq, 1)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+
+    qb_spec = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
+    qb1_spec = pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0))
+    kb_spec = pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0))
+    full_q = pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0))
+    full_q1 = pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0))
+    full_k = pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, seq_k=sk,
+                          scale=scale, causal=causal, block_q=block_q),
+        grid=(bh, sq // block_q),
+        in_specs=[qb_spec, full_k, full_k, qb_spec, qb1_spec, qb1_spec],
+        out_specs=qb_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, delta3)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, seq_q=sq,
+                          scale=scale, causal=causal, block_k=block_k),
+        grid=(bh, sk // block_k),
+        in_specs=[full_q, kb_spec, kb_spec, full_q, full_q1, full_q1],
+        out_specs=[kb_spec, kb_spec],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, delta3)
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+# ---------------------------------------------------------------------------
 # Differentiable wrapper: pallas forward, blockwise-recompute backward.
 # ---------------------------------------------------------------------------
 
@@ -176,21 +333,31 @@ def _flash(q, k, v, causal, scale, block_q, block_k):
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
+    from jax.ad_checkpoint import checkpoint_name
+
     o, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
                                interpret=not _on_tpu())
+    # Named so remat policies (gpt2 "dots_attn") can save BOTH outputs:
+    # with o and lse saved, the rematerialized forward's kernel call is
+    # dead code and the backward never re-runs flash.
+    o = checkpoint_name(o, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return o, (q, k, v, o, lse)
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, res, do):
-    """Blockwise backward in plain XLA: recompute P per K block from the
-    saved LSE (no S×S materialization across blocks).
-
-    Matmul operands stay in the input dtype (bf16) — only accumulation is
-    fp32 via ``preferred_element_type`` — so every einsum rides the MXU
-    fast path; intermediates P/dS are cast down before re-entering dots.
+    """Backward: pallas kernels (dq + dk/dv) when shapes tile; XLA
+    blockwise recompute otherwise. Both recompute P per block from the
+    saved LSE (no S×S materialization across blocks) with bf16 matmul
+    operands and fp32 accumulation.
     """
     q, k, v, o, lse = res
     sq, sk = q.shape[2], k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    if sq % bq == 0 and sk % bk == 0:
+        return _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale,
+                                 bq, bk, interpret=not _on_tpu())
 
     # delta = rowsum(dO * O), fp32 elementwise (cheap, bandwidth-bound)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
