@@ -8,6 +8,7 @@ RolloutWorker/WorkerSet, SampleBatch, env abstractions).
 from .algorithm import Algorithm, AlgorithmConfig, WorkerSet
 from .dqn import DQN, DQNConfig
 from .env import FastCartPole, GymVectorEnv, VectorEnv, make_env
+from .impala import Impala, ImpalaConfig, vtrace
 from .policy import JaxPolicy
 from .ppo import PPO, PPOConfig
 from .replay_buffers import (
@@ -21,7 +22,8 @@ from .sample_batch import SampleBatch, compute_gae
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "DQN", "DQNConfig", "FastCartPole",
-    "GymVectorEnv", "JaxPolicy", "MultiAgentReplayBuffer", "PPO",
+    "GymVectorEnv", "Impala", "ImpalaConfig", "JaxPolicy",
+    "MultiAgentReplayBuffer", "PPO",
     "PPOConfig", "PrioritizedReplayBuffer", "ReplayBuffer",
     "ReservoirReplayBuffer", "RolloutWorker", "SampleBatch", "VectorEnv",
     "WorkerSet", "compute_gae", "make_env",

@@ -1,0 +1,224 @@
+"""IMPALA: asynchronous actor-learner RL with V-trace correction.
+
+Reference analog: ``rllib/algorithms/impala/`` — rollout actors sample
+continuously and ship fragments to a central learner; the learner
+corrects for policy lag with V-trace (Espeholt et al. 2018,
+``vtrace_torch.py``) and streams updated weights back.
+
+TPU-first shape: the learner update is one jit-compiled program (device
+resident); rollout workers are CPU actors polled with ``wait`` so the
+learner never blocks on the slowest worker — the async pipeline is the
+point of IMPALA vs synchronous PPO.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .policy import forward_mlp
+from .sample_batch import (
+    ACTIONS,
+    DONES,
+    LOGPS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+
+
+def vtrace(behavior_logp, target_logp, rewards, dones, values, bootstrap,
+           gamma: float, rho_clip: float = 1.0, c_clip: float = 1.0
+           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """V-trace targets and policy-gradient advantages.
+
+    All inputs time-major [T, B]; ``bootstrap`` [B] is V(x_T) under the
+    *target* policy. Returns (vs, pg_advantages), both [T, B] and safe to
+    ``stop_gradient`` (already detached here).
+    """
+    rho = jnp.exp(target_logp - behavior_logp)
+    rho_c = jnp.minimum(rho, rho_clip)
+    c = jnp.minimum(rho, c_clip)
+    not_done = 1.0 - dones.astype(jnp.float32)
+    next_values = jnp.concatenate([values[1:], bootstrap[None]], axis=0)
+    deltas = rho_c * (rewards + gamma * not_done * next_values - values)
+
+    def scan_fn(acc, inp):
+        delta_t, c_t, nd_t = inp
+        acc = delta_t + gamma * nd_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        scan_fn, jnp.zeros_like(bootstrap),
+        (deltas, c, not_done), reverse=True)
+    vs = values + vs_minus_v
+    vs_next = jnp.concatenate([vs[1:], bootstrap[None]], axis=0)
+    pg_adv = rho_c * (rewards + gamma * not_done * vs_next - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+def impala_loss(params, batch, gamma, vf_coeff, ent_coeff):
+    """batch: time-major [T, B] columns + final_obs [B, obs]."""
+    obs = batch[OBS]
+    t_len, n = obs.shape[:2]
+    flat_obs = obs.reshape((t_len * n,) + obs.shape[2:])
+    logits, values = forward_mlp(params, flat_obs)
+    logits = logits.reshape(t_len, n, -1)
+    values = values.reshape(t_len, n)
+    logp_all = jax.nn.log_softmax(logits)
+    actions = batch[ACTIONS].astype(jnp.int32)
+    target_logp = jnp.take_along_axis(
+        logp_all, actions[..., None], axis=-1)[..., 0]
+    _, bootstrap = forward_mlp(params, batch["final_obs"])
+
+    vs, pg_adv = vtrace(batch[LOGPS], target_logp, batch[REWARDS],
+                        batch[DONES], values, bootstrap, gamma)
+    pg_loss = -jnp.mean(target_logp * pg_adv)
+    vf_loss = 0.5 * jnp.mean((values - vs) ** 2)
+    entropy = -jnp.mean(
+        jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    loss = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+    return loss, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                  "entropy": entropy}
+
+
+class ImpalaConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self._algo_class = Impala
+        self.lr = 5e-4
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.rollout_fragment_length = 64
+        self.num_batches_per_iter = 8  # learner updates per train() call
+        self.grad_clip = 40.0
+
+    def training(self, **kwargs) -> "ImpalaConfig":
+        for k in ("vf_coeff", "entropy_coeff", "num_batches_per_iter",
+                  "grad_clip"):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        super().training(**kwargs)
+        return self
+
+
+class Impala(Algorithm):
+    """Async actor-learner loop.
+
+    ``training_step``: keep one in-flight ``sample`` per remote worker;
+    consume whichever finishes first (``wait(num_returns=1)``), update,
+    push fresh weights to that worker only, resubmit. Synchronous
+    fallback when num_rollout_workers == 0.
+    """
+
+    def setup(self, config: ImpalaConfig) -> None:
+        import optax
+
+        super().setup(config)
+        self.params = self.workers.local_worker.policy.params
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip),
+            optax.adam(config.lr),
+        )
+        self.opt_state = self.optimizer.init(self.params)
+        self._num_updates = 0
+        self._in_flight: Dict = {}  # ref -> worker
+
+        gamma = config.gamma
+        vf_coeff, ent_coeff = config.vf_coeff, config.entropy_coeff
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                impala_loss, has_aux=True)(params, batch, gamma,
+                                           vf_coeff, ent_coeff)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, metrics
+
+        self._update = update
+
+    def _learn_on(self, batch: SampleBatch) -> Tuple[float, Dict]:
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()
+                  if k != "last_values"}
+        self.params, self.opt_state, loss, metrics = self._update(
+            self.params, self.opt_state, jbatch)
+        self._num_updates += 1
+        return float(loss), metrics
+
+    def training_step(self) -> Dict:
+        from ..core import get, put, wait
+
+        cfg = self.config
+        new_steps = 0
+        losses: List[float] = []
+
+        if not self.workers.remote_workers:
+            # Degenerate sync mode: still exercises the V-trace learner.
+            for _ in range(cfg.num_batches_per_iter):
+                batch = self.workers.local_worker.sample(
+                    cfg.rollout_fragment_length)
+                new_steps += batch[OBS].shape[0] * batch[OBS].shape[1]
+                loss, _ = self._learn_on(batch)
+                losses.append(loss)
+                self.workers.local_worker.set_weights(
+                    jax.tree.map(np.asarray, self.params))
+        else:
+            for w in self.workers.remote_workers:
+                if not any(worker is w for worker in
+                           self._in_flight.values()):
+                    self._in_flight[w.sample.remote(
+                        cfg.rollout_fragment_length)] = w
+            for _ in range(cfg.num_batches_per_iter):
+                ready, _ = wait(list(self._in_flight), num_returns=1,
+                                timeout=60)
+                if not ready:
+                    break
+                ref = ready[0]
+                worker = self._in_flight.pop(ref)
+                batch = get(ref)
+                new_steps += batch[OBS].shape[0] * batch[OBS].shape[1]
+                loss, _ = self._learn_on(batch)
+                losses.append(loss)
+                # Stream fresh weights to THIS worker only, then keep it
+                # sampling (async: others never blocked on the update).
+                weights_ref = put(jax.tree.map(np.asarray, self.params))
+                worker.set_weights.remote(weights_ref)
+                self._in_flight[worker.sample.remote(
+                    cfg.rollout_fragment_length)] = worker
+            self.workers.local_worker.set_weights(
+                jax.tree.map(np.asarray, self.params))
+
+        self._timesteps_total += new_steps
+        return {
+            "timesteps_this_iter": new_steps,
+            "num_learner_updates": self._num_updates,
+            "loss": float(np.mean(losses)) if losses else None,
+        }
+
+    def get_state(self) -> Dict:
+        state = super().get_state()
+        state.update({
+            "params": jax.tree.map(np.asarray, self.params),
+            "num_updates": self._num_updates,
+        })
+        return state
+
+    def set_state(self, state: Dict) -> None:
+        super().set_state(state)
+        if "params" in state:
+            self.params = jax.tree.map(jnp.asarray, state["params"])
+            self._num_updates = state.get("num_updates", 0)
+            weights = jax.tree.map(np.asarray, self.params)
+            self.workers.local_worker.set_weights(weights)
+            self.workers.sync_weights(weights)
+
+    def stop(self) -> None:
+        self._in_flight.clear()
+        super().stop()

@@ -162,6 +162,7 @@ class PPO(Algorithm):
         processed = []
         for frag in fragments:
             last_values = frag.pop("last_values")
+            frag.pop("final_obs", None)  # IMPALA-only bootstrap column
             frag = compute_gae(frag, last_values, cfg.gamma, cfg.lambda_)
             processed.append(flatten_time_major(frag))
         train_batch = SampleBatch.concat_samples(processed)

@@ -97,6 +97,10 @@ class RolloutWorker:
             VF_PREDS: vf_buf, REWARDS: rew_buf, DONES: done_buf,
         })
         batch["last_values"] = np.asarray(last_values, np.float32)
+        # Final observation [N, obs]: V-trace bootstraps V(x_T) under the
+        # *learner's* policy (IMPALA), so ship the state, not just the
+        # behavior-policy value estimate.
+        batch["final_obs"] = np.asarray(self._obs, np.float32)
         return batch
 
     def episode_stats(self, clear: bool = True) -> Dict:

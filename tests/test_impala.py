@@ -1,0 +1,121 @@
+"""IMPALA tests: V-trace math, async learner loop, learning smoke.
+
+Reference coverage analog: rllib/algorithms/impala/tests/test_impala.py
+and test_vtrace.py (V-trace vs ground truth on hand-checkable cases).
+"""
+
+import numpy as np
+import pytest
+
+
+def test_vtrace_on_policy_reduces_to_td_lambda_targets():
+    """With rho == 1 (on-policy) and c == 1, vs equals the discounted
+    Monte-Carlo/bootstrap targets of the trajectory."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib import vtrace
+
+    t_len, n = 4, 1
+    rewards = jnp.ones((t_len, n))
+    dones = jnp.zeros((t_len, n))
+    values = jnp.zeros((t_len, n))
+    logp = jnp.zeros((t_len, n))  # behavior == target
+    bootstrap = jnp.zeros((n,))
+    vs, pg_adv = vtrace(logp, logp, rewards, dones, values, bootstrap,
+                        gamma=1.0)
+    # vs[t] = sum of future rewards (4, 3, 2, 1); advantage equals it too
+    np.testing.assert_allclose(np.asarray(vs)[:, 0], [4, 3, 2, 1], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(pg_adv)[:, 0], [4, 3, 2, 1],
+                               atol=1e-5)
+
+
+def test_vtrace_clips_large_ratios():
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib import vtrace
+
+    t_len, n = 3, 1
+    rewards = jnp.ones((t_len, n))
+    dones = jnp.zeros((t_len, n))
+    values = jnp.zeros((t_len, n))
+    behavior = jnp.zeros((t_len, n))
+    target = jnp.full((t_len, n), 5.0)  # rho = e^5, clipped to 1
+    bootstrap = jnp.zeros((n,))
+    vs_clipped, _ = vtrace(behavior, target, rewards, dones, values,
+                           bootstrap, gamma=1.0)
+    vs_onpolicy, _ = vtrace(behavior, behavior, rewards, dones, values,
+                            bootstrap, gamma=1.0)
+    np.testing.assert_allclose(np.asarray(vs_clipped),
+                               np.asarray(vs_onpolicy), atol=1e-4)
+
+
+def test_vtrace_respects_dones():
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib import vtrace
+
+    rewards = jnp.ones((3, 1))
+    dones = jnp.asarray([[0.0], [1.0], [0.0]])
+    values = jnp.zeros((3, 1))
+    logp = jnp.zeros((3, 1))
+    vs, _ = vtrace(logp, logp, rewards, dones, values,
+                   jnp.full((1,), 100.0), gamma=1.0)
+    # Episode ends at t=1: vs[0] = 1 + 1 = 2, no leakage of the huge
+    # bootstrap across the boundary; vs[2] = 1 + 100 (bootstrap applies).
+    np.testing.assert_allclose(np.asarray(vs)[:, 0], [2, 1, 101], atol=1e-4)
+
+
+def test_impala_sync_iteration(rt_shared):
+    from ray_tpu.rllib import ImpalaConfig
+
+    algo = (ImpalaConfig()
+            .environment("FastCartPole")
+            .rollouts(num_rollout_workers=0, num_envs_per_worker=4,
+                      rollout_fragment_length=16)
+            .training(num_batches_per_iter=2)
+            .build())
+    result = algo.train()
+    assert result["timesteps_this_iter"] == 2 * 4 * 16
+    assert result["num_learner_updates"] == 2
+    assert np.isfinite(result["loss"])
+    algo.stop()
+
+
+def test_impala_async_workers(rt_shared):
+    from ray_tpu.rllib import ImpalaConfig
+
+    algo = (ImpalaConfig()
+            .environment("FastCartPole")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                      rollout_fragment_length=16)
+            .training(num_batches_per_iter=4)
+            .build())
+    r1 = algo.train()
+    assert r1["num_learner_updates"] == 4
+    assert r1["timesteps_this_iter"] == 4 * 2 * 16
+    r2 = algo.train()  # in-flight pipeline keeps flowing across iters
+    assert r2["num_learner_updates"] == 8
+    algo.stop()
+
+
+@pytest.mark.slow
+def test_impala_learns_cartpole(rt_shared):
+    from ray_tpu.rllib import ImpalaConfig
+
+    algo = (ImpalaConfig()
+            .environment("FastCartPole")
+            .rollouts(num_rollout_workers=0, num_envs_per_worker=16,
+                      rollout_fragment_length=64)
+            .training(lr=3e-3, num_batches_per_iter=8, entropy_coeff=0.003)
+            .debugging(seed=0)
+            .build())
+    best = 0.0
+    for _ in range(25):
+        result = algo.train()
+        r = result.get("episode_reward_mean")
+        if r is not None:
+            best = max(best, r)
+        if best >= 100:
+            break
+    algo.stop()
+    assert best >= 100, f"IMPALA failed to learn CartPole (best={best})"
