@@ -105,6 +105,12 @@ _define("ici_transfer_hint_bytes", int, 64 * 1024**2,
         "Hint: device arrays above this prefer resharding over host transfer.")
 
 # --- Observability -----------------------------------------------------------
+_define("log_to_driver", bool, True,
+        "Echo worker log lines to the driver's stdout/stderr "
+        "(reference: log_monitor.py -> driver printer).")
+_define("worker_redirect_logs", bool, True,
+        "Redirect worker stdout/stderr to session log files tailed by "
+        "the log monitor.")
 _define("metrics_report_interval_ms", int, 1000, "Metrics flush interval.")
 _define("event_log_max_bytes", int, 64 * 1024**2, "Structured event log cap.")
 _define("debug_dump_period_ms", int, 10_000,

@@ -1,0 +1,145 @@
+"""Log monitor: tail per-worker log files, publish lines to the driver.
+
+Reference analog: ``python/ray/_private/log_monitor.py`` — each worker's
+stdout/stderr goes to files under the session dir; the log monitor tails
+them and publishes lines over GCS pubsub, which the driver prints as
+``(worker pid=...) line``.
+
+Here: workers redirect to ``$RT_SESSION_LOG_DIR/worker-<id>.{out,err}``
+(``worker_main.worker_entry``); the head runtime runs one
+:class:`LogMonitor` thread that tails the directory and publishes to the
+``LOGS`` pubsub channel; ``attach_driver_printer`` subscribes and echoes
+to the driver's stdout.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, Optional, TextIO
+
+ENV_LOG_DIR = "RT_SESSION_LOG_DIR"
+CHANNEL = "LOGS"
+
+
+def make_session_log_dir(base: Optional[str] = None) -> str:
+    import uuid
+
+    base = base or os.environ.get("TMPDIR", "/tmp")
+    # Unique per init, not just per pid: re-init in one process (tests,
+    # notebooks) must not re-publish the previous session's log files.
+    path = os.path.join(
+        base, f"rt_session_{os.getpid()}_{uuid.uuid4().hex[:8]}", "logs")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def redirect_worker_streams(worker_id_hex: str) -> None:
+    """Called inside worker processes: stdout/stderr -> session log files.
+
+    fd-level dup2 so child processes and C extensions are captured too
+    (reference: workers open their log files and dup2 at startup).
+    """
+    log_dir = os.environ.get(ENV_LOG_DIR)
+    if not log_dir or os.environ.get("RT_LOG_TO_FILES") == "0":
+        return
+    try:
+        os.makedirs(log_dir, exist_ok=True)
+        short = worker_id_hex[:8]
+        out = open(os.path.join(log_dir, f"worker-{short}.out"), "a",
+                   buffering=1)
+        err = open(os.path.join(log_dir, f"worker-{short}.err"), "a",
+                   buffering=1)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.dup2(out.fileno(), 1)
+        os.dup2(err.fileno(), 2)
+        sys.stdout = out
+        sys.stderr = err
+    except OSError:
+        pass  # logging must never kill a worker
+
+
+class LogMonitor:
+    """Head-side tailer: session log dir -> pubsub ``LOGS`` channel."""
+
+    def __init__(self, log_dir: str, publish: Callable[[str, dict], None],
+                 poll_s: float = 0.2):
+        self.log_dir = log_dir
+        self._publish = publish
+        self._poll_s = poll_s
+        self._offsets: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rt-log-monitor")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            self.poll_once()
+        self.poll_once()  # final drain on shutdown
+
+    def poll_once(self) -> int:
+        """Tail every log file once; returns number of lines published."""
+        published = 0
+        try:
+            names = sorted(os.listdir(self.log_dir))
+        except OSError:
+            return 0
+        for name in names:
+            if not name.startswith("worker-"):
+                continue
+            path = os.path.join(self.log_dir, name)
+            worker, _, stream = name.partition(".")
+            offset = self._offsets.get(name, 0)
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    raw = f.read()
+            except OSError:
+                continue
+            # Consume only complete lines: a writer mid-line must not get
+            # its line split into two published messages.
+            last_nl = raw.rfind(b"\n")
+            if last_nl < 0:
+                continue
+            self._offsets[name] = offset + last_nl + 1
+            chunk = raw[: last_nl + 1].decode("utf-8", errors="replace")
+            for line in chunk.splitlines():
+                if line:
+                    self._publish(CHANNEL, {
+                        "worker": worker[len("worker-"):],
+                        "stream": stream or "out",
+                        "line": line,
+                    })
+                    published += 1
+        return published
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+def attach_driver_printer(pubsub, stream: TextIO = None
+                          ) -> Callable[[], None]:
+    """Subscribe to LOGS and echo lines as ``(worker=xxxx) line``
+    (reference: the driver's log deduplicator/printer)."""
+
+    def on_log(msg) -> None:
+        try:
+            out = stream or sys.stdout
+            prefix = f"(worker={msg['worker']})"
+            if msg.get("stream") == "err":
+                out = stream or sys.stderr
+            print(f"{prefix} {msg['line']}", file=out)
+        except Exception:
+            pass
+
+    return pubsub.subscribe(CHANNEL, on_log)

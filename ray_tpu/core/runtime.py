@@ -120,6 +120,16 @@ class Runtime:
         self._blocked_workers: Dict[bytes, NodeManager] = {}
         self._put_counter = 0
         self._env = dict(env or {})
+        # Session log dir: workers redirect stdout/stderr there; the log
+        # monitor tails the files and republishes to the driver
+        # (reference: log_monitor.py + session_latest/logs layout).
+        from .log_monitor import ENV_LOG_DIR, make_session_log_dir
+
+        if config().worker_redirect_logs:
+            self.session_log_dir: Optional[str] = make_session_log_dir()
+            self._env.setdefault(ENV_LOG_DIR, self.session_log_dir)
+        else:
+            self.session_log_dir = None
         self.gcs.add_job(JobInfo(self.job_id, entrypoint="driver"))
         from .placement_group import PlacementGroupManager
 
@@ -171,6 +181,18 @@ class Runtime:
         )
         if config().memory_monitor_enabled:
             self.memory_monitor.start()
+        self.log_monitor = None
+        self._log_unsub = None
+        if self.session_log_dir is not None:
+            from .log_monitor import LogMonitor, attach_driver_printer
+
+            self.log_monitor = LogMonitor(
+                self.session_log_dir,
+                publish=self.gcs.pubsub.publish,
+            )
+            self.log_monitor.start()
+            if config().log_to_driver:
+                self._log_unsub = attach_driver_printer(self.gcs.pubsub)
         install_refcount_hooks(
             add=self._ref_added, remove=self._ref_removed, borrow=self._ref_added
         )
@@ -1151,6 +1173,10 @@ class Runtime:
         install_refcount_hooks()
         self._hb_stop.set()
         self.memory_monitor.stop()
+        if self.log_monitor is not None:
+            self.log_monitor.stop()
+        if self._log_unsub is not None:
+            self._log_unsub()
         self.scheduler.shutdown()
         self.gcs.shutdown()
 
@@ -1177,6 +1203,7 @@ def init(num_cpus: Optional[float] = None, num_nodes: int = 1,
          resources: Optional[Dict[str, float]] = None,
          object_store_memory: Optional[int] = None,
          ignore_reinit_error: bool = False,
+         storage: Optional[str] = None,
          env: Optional[dict] = None, **kwargs) -> Runtime:
     global _runtime
     with _init_lock:
@@ -1185,6 +1212,12 @@ def init(num_cpus: Optional[float] = None, num_nodes: int = 1,
                 return _runtime
             raise RuntimeError("runtime already initialized; "
                                "pass ignore_reinit_error=True to reuse")
+        if storage is not None:
+            from .storage import ENV_STORAGE_URI, _init_storage
+
+            _init_storage(storage)
+            env = dict(env or {})
+            env.setdefault(ENV_STORAGE_URI, storage)  # workers inherit
         _runtime = Runtime(num_cpus=num_cpus, num_nodes=num_nodes,
                            resources=resources,
                            object_store_memory=object_store_memory, env=env)
@@ -1197,6 +1230,9 @@ def shutdown() -> None:
         if _runtime is not None:
             _runtime.shutdown()
             _runtime = None
+            from .storage import _init_storage
+
+            _init_storage(None)  # don't leak storage into the next init
 
 
 def is_initialized() -> bool:

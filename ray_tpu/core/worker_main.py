@@ -327,6 +327,9 @@ def worker_entry(conn, worker_id_hex: str, node_id_hex: str, env: dict) -> None:
     os.environ.update(env or {})
     # Make this process identifiable in `ps` (reference: setproctitle).
     sys.argv[0] = f"rt::worker::{worker_id_hex[:8]}"
+    from .log_monitor import redirect_worker_streams
+
+    redirect_worker_streams(worker_id_hex)
     _worker_runtime = WorkerRuntime(conn, worker_id_hex, node_id_hex)
     # Route the public API to this runtime inside the worker process.
     from . import runtime as runtime_mod

@@ -1,0 +1,85 @@
+"""Log monitor tests: worker stdout redirection -> tail -> driver echo.
+
+Reference coverage analog: log monitor tests in python/ray/tests/ —
+worker print() output reaches the driver with a worker prefix.
+"""
+
+import os
+import time
+
+import pytest
+
+
+def test_log_monitor_tails_and_publishes(tmp_path):
+    from ray_tpu.core.log_monitor import LogMonitor
+
+    published = []
+    mon = LogMonitor(str(tmp_path),
+                     publish=lambda ch, msg: published.append((ch, msg)))
+    log = tmp_path / "worker-abcd1234.out"
+    log.write_text("line one\n")
+    assert mon.poll_once() == 1
+    log.write_text("line one\nline two\n")  # append
+    assert mon.poll_once() == 1  # only the new line
+    assert published[0][0] == "LOGS"
+    assert published[0][1] == {"worker": "abcd1234", "stream": "out",
+                               "line": "line one"}
+    assert published[1][1]["line"] == "line two"
+
+
+def test_log_monitor_err_stream(tmp_path):
+    from ray_tpu.core.log_monitor import LogMonitor
+
+    published = []
+    mon = LogMonitor(str(tmp_path),
+                     publish=lambda ch, msg: published.append(msg))
+    (tmp_path / "worker-beef0000.err").write_text("oops\n")
+    mon.poll_once()
+    assert published == [{"worker": "beef0000", "stream": "err",
+                          "line": "oops"}]
+
+
+def test_worker_prints_reach_driver(rt_init, capfd):
+    """End-to-end: a task's print() appears on the driver's stdout with
+    the worker prefix (reference: '(worker pid=...) hello')."""
+    rt = rt_init
+
+    @rt.remote
+    def chatty():
+        print("hello from the worker")
+        return 1
+
+    assert rt.get(chatty.remote()) == 1
+    deadline = time.monotonic() + 5
+    seen = ""
+    while time.monotonic() < deadline:
+        seen += capfd.readouterr().out
+        if "hello from the worker" in seen:
+            break
+        time.sleep(0.1)
+    assert "hello from the worker" in seen
+    assert "(worker=" in seen
+
+
+def test_redirect_disabled_by_config(monkeypatch):
+    monkeypatch.setenv("RT_WORKER_REDIRECT_LOGS", "0")
+    from ray_tpu.core.config import Config
+
+    Config.reset()
+    import ray_tpu as rt
+
+    rt.init(num_cpus=2)
+    try:
+        from ray_tpu.core.runtime import get_runtime
+
+        assert get_runtime().session_log_dir is None
+        assert get_runtime().log_monitor is None
+
+        @rt.remote
+        def f():
+            return 2
+
+        assert rt.get(f.remote()) == 2
+    finally:
+        rt.shutdown()
+        Config.reset()

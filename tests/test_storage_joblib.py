@@ -1,0 +1,100 @@
+"""Storage API + joblib backend tests.
+
+Reference coverage analog: python/ray/tests/test_storage.py and
+ray.util.joblib tests.
+"""
+
+import pytest
+
+
+def test_storage_client_roundtrip(tmp_path):
+    from ray_tpu.core import storage
+
+    storage._init_storage(str(tmp_path))
+    try:
+        client = storage.get_client("ns1")
+        assert client.get("missing") is None
+        client.put("a/b.bin", b"payload")
+        assert client.get("a/b.bin") == b"payload"
+        assert client.exists("a/b.bin")
+        client.put("a/c.bin", b"x")
+        assert client.list("a") == ["a/b.bin", "a/c.bin"]
+        # scoped prefixes are disjoint
+        other = storage.get_client("ns2")
+        assert other.get("a/b.bin") is None
+        assert client.delete("a/b.bin")
+        assert not client.delete("a/b.bin")
+        assert client.delete_dir("a")
+    finally:
+        storage._init_storage(None)
+
+
+def test_storage_key_escape_rejected(tmp_path):
+    from ray_tpu.core import storage
+
+    storage._init_storage(str(tmp_path))
+    try:
+        client = storage.get_client("ns")
+        with pytest.raises(ValueError):
+            client.put("../escape", b"nope")
+        # Sibling whose name shares the prefix ("ns" vs "ns2"): a bare
+        # startswith check wrongly admits this.
+        ns2 = storage.get_client("ns2")
+        ns2.put("secret", b"mine")
+        with pytest.raises(ValueError):
+            client.get("../ns2/secret")
+    finally:
+        storage._init_storage(None)
+
+
+def test_storage_visible_inside_workers(tmp_path):
+    import ray_tpu as rt
+    from ray_tpu.core import storage
+
+    rt.init(num_cpus=2, storage=str(tmp_path))
+    try:
+        @rt.remote
+        def write_from_worker():
+            from ray_tpu.core import storage as s
+
+            s.get_client("wf").put("from-worker", b"ok")
+            return True
+
+        assert rt.get(write_from_worker.remote())
+        assert storage.get_client("wf").get("from-worker") == b"ok"
+    finally:
+        rt.shutdown()
+        storage._init_storage(None)
+
+
+def test_storage_unconfigured_raises():
+    from ray_tpu.core import storage
+
+    assert storage.get_storage_uri() is None
+    with pytest.raises(RuntimeError):
+        storage.get_client()
+
+
+def test_init_accepts_storage(tmp_path, monkeypatch):
+    import ray_tpu as rt
+    from ray_tpu.core import storage
+
+    rt.init(num_cpus=2, storage=str(tmp_path))
+    try:
+        client = storage.get_client("workflow")
+        client.put("k", b"v")
+        assert client.get("k") == b"v"
+    finally:
+        rt.shutdown()
+        storage._init_storage(None)
+
+
+def test_joblib_backend(rt_shared):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        results = joblib.Parallel(n_jobs=4)(
+            joblib.delayed(lambda x: x * x)(i) for i in range(10))
+    assert results == [i * i for i in range(10)]
