@@ -25,6 +25,7 @@ def rt_shared_module():
 
     rt.init(num_cpus=4, ignore_reinit_error=True)
     yield rt
+    rt.shutdown()
 
 
 def test_put_get_roundtrip(client):
