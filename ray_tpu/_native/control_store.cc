@@ -361,6 +361,80 @@ class ControlStore {
 std::atomic<bool> g_shutdown{false};
 std::atomic<int> g_listen_fd{-1};
 
+// ---------------------------------------------------------------------------
+// Persistence: append-only mutation log, replayed on startup.
+// Reference analog: GcsTableStorage over RedisStoreClient — restartable
+// control-plane state. Only durable mutations are logged (KV put/del,
+// node register/mark-dead); heartbeats and pubsub are runtime-only.
+// Record format: u32 len | raw request frame (op byte + fields).
+// ---------------------------------------------------------------------------
+
+std::FILE* g_persist = nullptr;
+std::mutex g_persist_mu;
+
+bool IsDurableOp(uint8_t op) {
+  return op == OP_KV_PUT || op == OP_KV_DEL || op == OP_NODE_REGISTER ||
+         op == OP_NODE_MARK_DEAD;
+}
+
+// Caller must hold g_persist_mu (the durable-op apply lock): log order
+// MUST equal apply order or replay reconstructs a different state than
+// the live store had (e.g. a lost no-overwrite race flips winners).
+void PersistFrameLocked(const std::vector<char>& frame) {
+  if (g_persist == nullptr) return;
+  uint32_t len = static_cast<uint32_t>(frame.size());
+  std::fwrite(&len, 4, 1, g_persist);
+  std::fwrite(frame.data(), 1, frame.size(), g_persist);
+  std::fflush(g_persist);
+}
+
+void ReplayLog(ControlStore* store, const char* path) {
+  std::FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) return;  // first start: nothing to replay
+  size_t replayed = 0;
+  for (;;) {
+    uint32_t len;
+    if (std::fread(&len, 4, 1, f) != 1) break;
+    if (len > (64u << 20)) break;  // corrupt tail
+    std::vector<char> frame(len);
+    if (std::fread(frame.data(), 1, len, f) != len) break;  // torn write
+    Reader r(frame);
+    uint8_t op;
+    if (!r.U8(&op)) break;
+    switch (op) {
+      case OP_KV_PUT: {
+        std::string ns, key, val;
+        uint8_t overwrite;
+        if (r.Bytes(&ns) && r.Bytes(&key) && r.Bytes(&val) &&
+            r.U8(&overwrite))
+          store->KvPut(ns, key, val, overwrite != 0);
+        break;
+      }
+      case OP_KV_DEL: {
+        std::string ns, key;
+        if (r.Bytes(&ns) && r.Bytes(&key)) store->KvDel(ns, key);
+        break;
+      }
+      case OP_NODE_REGISTER: {
+        std::string id, info;
+        if (r.Bytes(&id) && r.Bytes(&info)) store->NodeRegister(id, info);
+        break;
+      }
+      case OP_NODE_MARK_DEAD: {
+        std::string id;
+        if (r.Bytes(&id)) store->NodeMarkDead(id);
+        break;
+      }
+      default:
+        break;
+    }
+    replayed++;
+  }
+  std::fclose(f);
+  std::fprintf(stderr, "control_store: replayed %zu mutations from %s\n",
+               replayed, path);
+}
+
 void HandleConnection(ControlStore* store, std::shared_ptr<Connection> conn) {
   for (;;) {
     uint32_t frame_len;
@@ -371,6 +445,15 @@ void HandleConnection(ControlStore* store, std::shared_ptr<Connection> conn) {
     Reader r(frame);
     uint8_t op;
     if (!r.U8(&op)) break;
+    // Durable ops serialize log+apply under one lock so the mutation log
+    // replays in exactly the order mutations took effect; the log write
+    // happens BEFORE the case sends its ack (write-ahead: an acked
+    // mutation is never lost to a crash between ack and append).
+    std::unique_lock<std::mutex> durable_lk;
+    if (IsDurableOp(op)) {
+      durable_lk = std::unique_lock<std::mutex>(g_persist_mu);
+      PersistFrameLocked(frame);
+    }
 
     switch (op) {
       case OP_PING: {
@@ -530,9 +613,11 @@ done:
 int main(int argc, char** argv) {
   int port = 0;  // 0 = ephemeral; actual port printed to stdout
   const char* host = "127.0.0.1";
+  const char* persist = nullptr;
   for (int i = 1; i < argc - 1; i++) {
     if (!std::strcmp(argv[i], "--port")) port = std::atoi(argv[i + 1]);
     if (!std::strcmp(argv[i], "--host")) host = argv[i + 1];
+    if (!std::strcmp(argv[i], "--persist")) persist = argv[i + 1];
   }
   ::signal(SIGPIPE, SIG_IGN);
 
@@ -559,11 +644,21 @@ int main(int argc, char** argv) {
     return 1;
   }
   g_listen_fd = listen_fd;
+
+  ControlStore store;
+  if (persist != nullptr) {
+    ReplayLog(&store, persist);
+    g_persist = std::fopen(persist, "ab");
+    if (g_persist == nullptr) {
+      // Exit BEFORE the port handshake: the launcher then fails loudly
+      // instead of running a daemon that silently isn't durable.
+      std::perror("persist open");
+      return 1;
+    }
+  }
   // Startup handshake: the launcher reads the bound port from stdout.
   std::printf("CONTROL_STORE_PORT %d\n", ntohs(addr.sin_port));
   std::fflush(stdout);
-
-  ControlStore store;
   std::vector<std::thread> workers;
   while (!g_shutdown) {
     int fd = ::accept(listen_fd, nullptr, nullptr);

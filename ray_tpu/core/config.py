@@ -83,6 +83,9 @@ _define("memory_usage_threshold", float, 0.95,
         "Node memory fraction above which the worker-killing policy fires.")
 _define("actor_max_restarts", int, 0, "Default actor restarts on failure.")
 
+_define("control_store_persist_path", str, "",
+        "Durable mutation log for the native control store; empty = "
+        "in-memory only (reference: Redis vs in-memory GCS storage).")
 _define("native_control_store", bool, False,
         "Back the control store's KV/pubsub/node-liveness with the native "
         "C++ daemon (ray_tpu/_native/control_store.cc) instead of the "

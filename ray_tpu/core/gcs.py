@@ -301,10 +301,12 @@ class NativeBackedControlStore(GlobalControlStore):
     """
 
     def __init__(self):
+        from .config import config
         from .gcs_socket import ControlStoreProcess
 
         super().__init__()
-        self._proc = ControlStoreProcess()
+        self._proc = ControlStoreProcess(
+            persist_path=config().control_store_persist_path or None)
         self._client = self._proc.client()
         self.pubsub = _NativePubsub(self._client)
         self._sync_thread: Optional[threading.Thread] = None

@@ -317,13 +317,19 @@ def build_native() -> bool:
 class ControlStoreProcess:
     """Owns a spawned daemon (start, port handshake, stop)."""
 
-    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 persist_path: Optional[str] = None):
         if not build_native():
             raise ControlStoreError(
                 "control_store binary unavailable (g++/make missing?)")
+        cmd = [_BINARY, "--port", str(port), "--host", host]
+        if persist_path:
+            # Durable mutation log (reference: Redis-backed GCS tables) —
+            # a restarted daemon replays KV + node state from it.
+            cmd += ["--persist", persist_path]
         self._proc = subprocess.Popen(
-            [_BINARY, "--port", str(port), "--host", host],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True,
         )
         line = self._proc.stdout.readline()
         if not line.startswith("CONTROL_STORE_PORT "):
