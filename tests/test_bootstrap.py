@@ -1,0 +1,128 @@
+"""Bootstrap rendezvous tests: rank claiming, coordinator publish, barrier.
+
+Reference coverage analog: collective group rendezvous tests
+(util/collective) — N processes agree on ranks + a coordinator through
+the store, without racing.
+"""
+
+import threading
+
+import pytest
+
+from ray_tpu.core.gcs_socket import ControlStoreProcess, build_native
+from ray_tpu.parallel.bootstrap import Bootstrap, BootstrapError
+
+pytestmark = pytest.mark.skipif(
+    not build_native(), reason="native toolchain unavailable")
+
+
+@pytest.fixture()
+def store():
+    proc = ControlStoreProcess()
+    clients = []
+
+    def make_client():
+        c = proc.client()
+        clients.append(c)
+        return c
+
+    yield make_client
+    for c in clients:
+        c.close()
+    proc.stop()
+
+
+def test_concurrent_rank_claims_are_disjoint(store):
+    world = 8
+    results = {}
+    errors = []
+    barrier = threading.Barrier(world)
+
+    def host(i):
+        try:
+            bs = Bootstrap(store(), world_size=world, session="s1")
+            barrier.wait()  # maximal contention
+            results[i] = bs.claim_rank()
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=host, args=(i,)) for i in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert sorted(results.values()) == list(range(world))
+
+
+def test_extra_host_rejected(store):
+    bs1 = Bootstrap(store(), world_size=1, session="s2")
+    assert bs1.claim_rank() == 0
+    bs2 = Bootstrap(store(), world_size=1, session="s2")
+    with pytest.raises(BootstrapError):
+        bs2.claim_rank()
+
+
+def test_rank_reclaim_idempotent(store):
+    client = store()
+    bs = Bootstrap(client, world_size=2, session="s3")
+    rank = bs.claim_rank()
+    # Same Bootstrap (same token) re-claims its own slot after a restart.
+    bs.rank = None
+    assert bs.claim_rank() == rank
+
+
+def test_coordinator_publish_and_poll(store):
+    world = 3
+    addresses = {}
+    done = threading.Barrier(world)
+
+    def host(i):
+        bs = Bootstrap(store(), world_size=world, session="s4")
+        bs.claim_rank()
+        addresses[bs.rank] = bs.coordinator_address(port=12345,
+                                                    timeout_s=10)
+        done.wait()
+
+    threads = [threading.Thread(target=host, args=(i,)) for i in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+    assert len(set(addresses.values())) == 1  # everyone agrees
+    assert addresses[0].endswith(":12345")
+
+
+def test_barrier_blocks_until_all_arrive(store):
+    world = 4
+    order = []
+
+    def host(i, delay):
+        import time
+
+        bs = Bootstrap(store(), world_size=world, session="s5")
+        bs.claim_rank()
+        time.sleep(delay)
+        bs.barrier("sync", timeout_s=10)
+        order.append(i)
+
+    threads = [threading.Thread(target=host, args=(i, 0.2 * i))
+               for i in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+    assert len(order) == world  # nobody timed out / deadlocked
+
+
+def test_bootstrap_against_inprocess_store():
+    """The same rendezvous works over the pure-Python control store."""
+    from ray_tpu.core.gcs import GlobalControlStore
+
+    gcs = GlobalControlStore()
+    bs0 = Bootstrap(gcs, world_size=2, session="inproc")
+    bs1 = Bootstrap(gcs, world_size=2, session="inproc")
+    assert bs0.claim_rank() == 0
+    assert bs1.claim_rank() == 1
+    addr = bs0.coordinator_address(port=9999)
+    assert bs1.coordinator_address(timeout_s=5) == addr
