@@ -72,3 +72,60 @@ class Cluster:
 
     def shutdown(self) -> None:
         runtime_mod.shutdown()
+
+
+class NodeKiller:
+    """Chaos fault injector: kills random non-head nodes on a timer.
+
+    Reference analog: ``_private/test_utils.get_and_run_node_killer``'s
+    ``NodeKillerActor`` (:1116) driving chaos release tests
+    (``release/nightly_tests/chaos_test/``) — workloads must survive
+    repeated node loss through lineage reconstruction and retries.
+    """
+
+    def __init__(self, cluster: Cluster, kill_interval_s: float = 1.0,
+                 max_kills: Optional[int] = None, seed: int = 0):
+        import random
+        import threading
+
+        self.cluster = cluster
+        self.kill_interval_s = kill_interval_s
+        self.max_kills = max_kills
+        self.killed: list = []
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _victims(self) -> list:
+        return [nid for nid in self.cluster._nodes
+                if nid != self.cluster.head_node_id]
+
+    def kill_one(self) -> Optional[NodeID]:
+        """Kill one random non-head node now; returns its id (or None)."""
+        victims = self._victims()
+        if not victims:
+            return None
+        node_id = self._rng.choice(victims)
+        self.cluster.remove_node(node_id)
+        self.killed.append(node_id)
+        return node_id
+
+    def run(self) -> None:
+        import threading
+
+        def loop():
+            while not self._stop.wait(self.kill_interval_s):
+                if (self.max_kills is not None
+                        and len(self.killed) >= self.max_kills):
+                    return
+                self.kill_one()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="rt-node-killer")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

@@ -233,6 +233,15 @@ class Runtime:
                 ):
                     entry.status = _ObjStatus.LOST
                     entry.location = None
+        # Kill first, then fail-or-retry: kill() marks the handle DEAD
+        # (suppressing the pool's on_worker_death callback) and stops the
+        # process, so a worker can't race a late "done" against the retry
+        # we schedule below. Without the explicit death pass, in-flight
+        # tasks would stay RUNNING forever (reference: NodeManager
+        # node-death cleanup fails leases; GCS actor manager restarts).
+        for worker in node.pool.all_workers():
+            worker.kill()
+            self._handle_worker_death(worker)
         node.shutdown()
         self.scheduler.notify()
 
