@@ -108,6 +108,9 @@ _define("ici_transfer_hint_bytes", int, 64 * 1024**2,
         "Hint: device arrays above this prefer resharding over host transfer.")
 
 # --- Observability -----------------------------------------------------------
+_define("tracing_enabled", bool, False,
+        "Record spans around task submission/execution (reference: "
+        "opt-in OpenTelemetry tracing, tracing_helper.py).")
 _define("log_to_driver", bool, True,
         "Echo worker log lines to the driver's stdout/stderr "
         "(reference: log_monitor.py -> driver printer).")

@@ -127,7 +127,15 @@ class RemoteFunction:
             name=opts["name"] or self._fn.__name__,
             runtime_env=dict(opts["runtime_env"]) if opts.get("runtime_env") else None,
         )
-        refs = rt.submit_spec(spec)
+        from ..observability import tracing
+
+        if tracing.get_tracer().enabled:
+            with tracing.span(f"task.submit {spec.name}",
+                              task_id=spec.task_id.hex()):
+                spec.trace_ctx = tracing.inject_context()
+                refs = rt.submit_spec(spec)
+        else:
+            refs = rt.submit_spec(spec)
         if opts["num_returns"] == 1:
             return refs[0]
         if opts["num_returns"] == 0:

@@ -120,6 +120,13 @@ class Runtime:
         self._blocked_workers: Dict[bytes, NodeManager] = {}
         self._put_counter = 0
         self._env = dict(env or {})
+        # Before any worker starts: tracing on the driver + inherited by
+        # every worker via env (config flag tracing_enabled).
+        if config().tracing_enabled:
+            from ..observability import tracing
+
+            tracing.enable()
+            self._env.setdefault("RT_TRACING_ENABLED", "1")
         # Session log dir: workers redirect stdout/stderr there; the log
         # monitor tails the files and republishes to the driver
         # (reference: log_monitor.py + session_latest/logs layout).
@@ -523,6 +530,7 @@ class Runtime:
             "max_concurrency": spec.max_concurrency,
             "name": spec.describe(),
             "runtime_env": spec.runtime_env,
+            "trace_ctx": spec.trace_ctx,
         }))
         if not ok:
             self._handle_worker_death(worker)
@@ -765,6 +773,7 @@ class Runtime:
             "resolved_args": resolved,
             "num_returns": spec.num_returns,
             "name": spec.describe(),
+            "trace_ctx": spec.trace_ctx,
         }))
         if not ok:
             self._handle_worker_death(record.worker)

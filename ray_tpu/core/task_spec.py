@@ -66,6 +66,10 @@ class TaskSpec:
     max_concurrency: int = 1
     name: str = ""
     runtime_env: Optional[dict] = None
+    # (trace_id, span_id) of the submitting span — execution spans on the
+    # worker join the submitter's trace (reference: tracing_helper.py
+    # propagates OpenTelemetry context inside the TaskSpec).
+    trace_ctx: Optional[Tuple[str, str]] = None
 
     def scheduling_key(self) -> Tuple:
         """Lease reuse key: same-shape tasks share leased workers.

@@ -253,12 +253,19 @@ class WorkerRuntime:
                 for i, entry in payload.get("resolved_args", {}).items()
             }
             args, kwargs = self._resolve_args(payload["args_frame"], resolved)
+            from ..observability import tracing
+
+            trace_cm = tracing.remote_context(payload.get("trace_ctx"))
+            span_cm = tracing.span(f"task.execute {payload.get('name', '')}",
+                                   task_id=task_id_hex)
             if task_type == TaskType.NORMAL_TASK:
                 fn = serialization.loads(payload["function_blob"])
-                result = fn(*args, **kwargs)
+                with trace_cm, span_cm:
+                    result = fn(*args, **kwargs)
             elif task_type == TaskType.ACTOR_CREATION_TASK:
                 cls = serialization.loads(payload["function_blob"])
-                instance = cls(*args, **kwargs)
+                with trace_cm, span_cm:
+                    instance = cls(*args, **kwargs)
                 actor_hex = payload["actor_id"]
                 self._actors[actor_hex] = instance
                 maxc = payload.get("max_concurrency", 1)
@@ -271,7 +278,8 @@ class WorkerRuntime:
                 if instance is None:
                     raise ActorError(msg="actor instance not found on worker")
                 method = getattr(instance, payload["method_name"])
-                result = method(*args, **kwargs)
+                with trace_cm, span_cm:
+                    result = method(*args, **kwargs)
                 import inspect
 
                 if inspect.iscoroutine(result):
@@ -330,6 +338,12 @@ def worker_entry(conn, worker_id_hex: str, node_id_hex: str, env: dict) -> None:
     from .log_monitor import redirect_worker_streams
 
     redirect_worker_streams(worker_id_hex)
+    from .config import config as _config
+
+    if _config().tracing_enabled:
+        from ..observability import tracing
+
+        tracing.enable()
     _worker_runtime = WorkerRuntime(conn, worker_id_hex, node_id_hex)
     # Route the public API to this runtime inside the worker process.
     from . import runtime as runtime_mod

@@ -1,0 +1,181 @@
+"""Tracing: spans around task/actor submission and execution.
+
+Reference analog: ``python/ray/util/tracing/tracing_helper.py`` —
+opt-in OpenTelemetry spans wrapping ``submit_task``/``execute_task``
+with trace context propagated inside the TaskSpec. Here spans are
+in-process records exported as chrome://tracing events
+(:meth:`Tracer.chrome_trace_events`), mergeable with the
+``observability.state.timeline`` output.
+
+Enable with ``tracing.enable()`` (or config flag ``tracing_enabled``);
+``@trace_span("name")`` / ``with span("name"):`` for app code.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+_local = threading.local()
+
+
+@dataclass
+class Span:
+    name: str
+    span_id: str
+    parent_id: Optional[str]
+    trace_id: str
+    start_s: float
+    end_s: Optional[float] = None
+    attributes: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> Optional[float]:
+        if self.end_s is None:
+            return None
+        return (self.end_s - self.start_s) * 1000.0
+
+
+class Tracer:
+    """Process-wide span collector (bounded ring)."""
+
+    def __init__(self, max_spans: int = 10_000):
+        self.enabled = False
+        self.max_spans = max_spans
+        self._spans: List[Span] = []
+        self._lock = threading.Lock()
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def record(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+            if len(self._spans) > self.max_spans:
+                self._spans = self._spans[-self.max_spans:]
+
+    def spans(self, name_prefix: str = "") -> List[Span]:
+        with self._lock:
+            return [s for s in self._spans if s.name.startswith(name_prefix)]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans = []
+
+    def chrome_trace_events(self) -> List[dict]:
+        """Spans as chrome://tracing 'X' (complete) events, mergeable
+        with ``observability.state.timeline`` output."""
+        with self._lock:
+            spans = list(self._spans)
+        events = []
+        for s in spans:
+            if s.end_s is None:
+                continue
+            events.append({
+                "name": s.name, "ph": "X", "cat": "span",
+                "ts": s.start_s * 1e6,
+                "dur": (s.end_s - s.start_s) * 1e6,
+                "pid": "spans", "tid": s.trace_id[:8],
+                "args": {**s.attributes, "span_id": s.span_id,
+                         "parent_id": s.parent_id},
+            })
+        return events
+
+
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def enable() -> None:
+    _tracer.enable()
+
+
+def disable() -> None:
+    _tracer.disable()
+
+
+def current_span() -> Optional[Span]:
+    stack = getattr(_local, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes) -> Iterator[Optional[Span]]:
+    """Context-managed span; nests under the thread's current span and
+    continues a propagated remote context when present."""
+    if not _tracer.enabled:
+        yield None
+        return
+    parent = current_span()
+    remote_ctx = getattr(_local, "remote_context", None)
+    if parent is not None:
+        trace_id, parent_id = parent.trace_id, parent.span_id
+    elif remote_ctx is not None:
+        trace_id, parent_id = remote_ctx
+    else:
+        trace_id, parent_id = uuid.uuid4().hex, None
+    s = Span(name=name, span_id=uuid.uuid4().hex[:16], parent_id=parent_id,
+             trace_id=trace_id, start_s=time.time(), attributes=attributes)
+    stack = getattr(_local, "stack", None)
+    if stack is None:
+        stack = _local.stack = []
+    stack.append(s)
+    try:
+        yield s
+    finally:
+        s.end_s = time.time()
+        stack.pop()
+        _tracer.record(s)
+
+
+def trace_span(name: Optional[str] = None, **attributes):
+    """Decorator form of :func:`span`."""
+
+    def wrap(fn):
+        span_name = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            with span(span_name, **attributes):
+                return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap
+
+
+# -- remote propagation (reference: trace context in TaskSpec) --------------
+
+def inject_context() -> Optional[tuple]:
+    """Capture (trace_id, span_id) to ship inside a TaskSpec."""
+    if not _tracer.enabled:
+        return None
+    s = current_span()
+    if s is None:
+        return None
+    return (s.trace_id, s.span_id)
+
+
+@contextlib.contextmanager
+def remote_context(ctx: Optional[tuple]) -> Iterator[None]:
+    """Worker-side: adopt the submitted task's trace context so execution
+    spans join the submitter's trace."""
+    if ctx is None:
+        yield
+        return
+    _local.remote_context = tuple(ctx)
+    try:
+        yield
+    finally:
+        _local.remote_context = None
