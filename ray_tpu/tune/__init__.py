@@ -17,6 +17,7 @@ from .search import (
     Domain,
     GridSearch,
     RandomSearch,
+    TPESearcher,
     Searcher,
     choice,
     grid_search,
@@ -38,7 +39,7 @@ from .tuner import (
 __all__ = [
     "AsyncHyperBandScheduler", "BasicVariantGenerator", "Choice", "Domain",
     "FIFOScheduler", "GridSearch", "MedianStoppingRule",
-    "PopulationBasedTraining", "RandomSearch", "ResultGrid", "Searcher",
+    "PopulationBasedTraining", "RandomSearch", "ResultGrid", "Searcher", "TPESearcher",
     "Trial", "TrialDecision", "TrialRunner", "TrialScheduler", "TrialStatus",
     "TuneConfig", "Tuner", "choice", "grid_search", "loguniform", "randint",
     "report", "run", "uniform",

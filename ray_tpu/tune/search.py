@@ -146,5 +146,157 @@ class BasicVariantGenerator(Searcher):
         return cfg
 
 
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (model-based search).
+
+    Reference analog: ``tune/search/hyperopt`` (HyperOptSearch wraps
+    hyperopt's TPE; Bergstra et al. 2011). Implementation here is
+    self-contained: after ``n_startup_trials`` random configs, completed
+    trials split into good/bad quantiles; candidates are drawn from a
+    kernel density over the good set and ranked by the density ratio
+    l(x)/g(x), independently per dimension.
+    """
+
+    def __init__(self, space: Dict, metric: str, mode: str = "min",
+                 n_startup_trials: int = 10, n_candidates: int = 24,
+                 gamma: float = 0.25, max_trials: Optional[int] = 64,
+                 seed: Optional[int] = None):
+        assert mode in ("min", "max")
+        # Unlike the finite variant generators, a model-based searcher can
+        # suggest forever — max_trials bounds the sweep (None = unbounded;
+        # the caller then owns termination).
+        for k, v in space.items():
+            if isinstance(v, GridSearch):
+                raise ValueError(
+                    f"TPESearcher does not expand grid_search ({k!r}); "
+                    "use Choice or BasicVariantGenerator")
+        self.space = space
+        self.metric = metric
+        self.mode = mode
+        self.n_startup = n_startup_trials
+        self.n_candidates = n_candidates
+        self.gamma = gamma
+        self.max_trials = max_trials
+        self._suggested = 0
+        self.rng = random.Random(seed)
+        self._live: Dict[str, Dict] = {}
+        self._history: List[tuple] = []  # (config, score)
+
+    # -- numeric transform per domain ------------------------------------
+    def _to_unit(self, key, value) -> Optional[float]:
+        dom = self.space[key]
+        if isinstance(dom, Uniform):
+            return (value - dom.low) / max(dom.high - dom.low, 1e-12)
+        if isinstance(dom, LogUniform):
+            lo, hi = math.log(dom.low), math.log(dom.high)
+            return (math.log(value) - lo) / max(hi - lo, 1e-12)
+        if isinstance(dom, RandInt):
+            return (value - dom.low) / max(dom.high - 1 - dom.low, 1)
+        return None  # Choice handled categorically
+
+    def _from_unit(self, key, unit: float):
+        dom = self.space[key]
+        unit = min(1.0, max(0.0, unit))
+        if isinstance(dom, Uniform):
+            return min(dom.high, max(dom.low,
+                                     dom.low + unit * (dom.high - dom.low)))
+        if isinstance(dom, LogUniform):
+            lo, hi = math.log(dom.low), math.log(dom.high)
+            # Clamp: exp(log-interpolation) can overshoot by 1 ulp.
+            return min(dom.high, max(dom.low,
+                                     math.exp(lo + unit * (hi - lo))))
+        if isinstance(dom, RandInt):
+            return int(round(dom.low + unit * (dom.high - 1 - dom.low)))
+        raise TypeError(key)
+
+    def _sample_random(self) -> Dict:
+        cfg = {}
+        for k, v in self.space.items():
+            if isinstance(v, Domain):
+                cfg[k] = v.sample(self.rng)
+            elif callable(v) and not isinstance(v, type):
+                cfg[k] = v()  # tune.sample_from style
+            else:
+                cfg[k] = v
+        return cfg
+
+    def _split(self):
+        scored = sorted(self._history, key=lambda cs: cs[1],
+                        reverse=(self.mode == "max"))
+        n_good = max(1, int(self.gamma * len(scored)))
+        return [c for c, _ in scored[:n_good]], [c for c, _ in scored[n_good:]]
+
+    @staticmethod
+    def _kde_logpdf(unit: float, points: List[float], bw: float) -> float:
+        if not points:
+            return 0.0
+        total = sum(math.exp(-0.5 * ((unit - p) / bw) ** 2) for p in points)
+        return math.log(total / len(points) + 1e-12)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if self.max_trials is not None and self._suggested >= self.max_trials:
+            return None
+        self._suggested += 1
+        if len(self._history) < self.n_startup or not self._history:
+            cfg = self._sample_random()
+            self._live[trial_id] = cfg
+            return cfg
+        good, bad = self._split()
+        bw = max(0.1, 1.0 / max(len(good), 1) ** 0.5)
+        # Candidate-independent per-key statistics, hoisted out of the
+        # candidate loop (they only depend on the good/bad split).
+        stats: Dict[str, tuple] = {}
+        for k, dom in self.space.items():
+            if isinstance(dom, Choice):
+                counts_g = {c: 1.0 for c in dom.categories}
+                for g in good:
+                    counts_g[g[k]] = counts_g.get(g[k], 1.0) + 1.0
+                counts_b = {c: 1.0 for c in dom.categories}
+                for b in bad:
+                    counts_b[b[k]] = counts_b.get(b[k], 1.0) + 1.0
+                stats[k] = (counts_g, counts_b)
+            elif isinstance(dom, Domain):
+                stats[k] = ([self._to_unit(k, g[k]) for g in good],
+                            [self._to_unit(k, b[k]) for b in bad])
+        best_cfg, best_score = None, -math.inf
+        for _ in range(self.n_candidates):
+            cand = {}
+            score = 0.0
+            for k, dom in self.space.items():
+                if isinstance(dom, Choice):
+                    # Categorical TPE: sample from good-frequencies,
+                    # score by smoothed count ratio.
+                    counts_g, counts_b = stats[k]
+                    cats, weights = zip(*counts_g.items())
+                    choice = self.rng.choices(cats, weights=weights)[0]
+                    score += (math.log(counts_g[choice] / max(len(good), 1))
+                              - math.log(counts_b[choice] / max(len(bad), 1)))
+                    cand[k] = choice
+                elif isinstance(dom, Domain):
+                    anchors, bad_units = stats[k]
+                    anchor = self.rng.choice(anchors)
+                    unit = anchor + self.rng.gauss(0.0, bw)
+                    cand[k] = self._from_unit(k, unit)
+                    unit = self._to_unit(k, cand[k])
+                    score += self._kde_logpdf(
+                        unit, anchors, bw) - self._kde_logpdf(
+                        unit, bad_units, bw)
+                elif callable(dom) and not isinstance(dom, type):
+                    cand[k] = dom()
+                else:
+                    cand[k] = dom
+            if score > best_score:
+                best_cfg, best_score = cand, score
+        self._live[trial_id] = best_cfg
+        return best_cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict],
+                          error: bool = False) -> None:
+        cfg = self._live.pop(trial_id, None)
+        if cfg is None or error or not result or self.metric not in result:
+            return
+        self._history.append((cfg, float(result[self.metric])))
+
+
 class RandomSearch(BasicVariantGenerator):
     """Pure random sampling (no grid keys required)."""

@@ -1,0 +1,235 @@
+"""Tracing spans + TPE searcher tests.
+
+Reference coverage analog: tracing_helper tests (spans around
+submit/execute, context propagation) and hyperopt searcher tests
+(model-based search beats random on a smooth objective).
+"""
+
+import random
+
+import pytest
+
+
+# -- tracing -----------------------------------------------------------------
+
+def test_span_nesting_and_records():
+    from ray_tpu.observability import tracing
+
+    tracer = tracing.get_tracer()
+    tracer.clear()
+    tracing.enable()
+    try:
+        with tracing.span("outer", kind="test") as outer:
+            with tracing.span("inner") as inner:
+                assert inner.parent_id == outer.span_id
+                assert inner.trace_id == outer.trace_id
+        spans = tracer.spans()
+        names = [s.name for s in spans]
+        assert names == ["inner", "outer"]  # completion order
+        assert all(s.duration_ms is not None for s in spans)
+        events = tracer.chrome_trace_events()
+        assert len(events) == 2 and events[0]["ph"] == "X"
+    finally:
+        tracing.disable()
+        tracer.clear()
+
+
+def test_disabled_tracer_is_noop():
+    from ray_tpu.observability import tracing
+
+    tracing.disable()
+    with tracing.span("ghost") as s:
+        assert s is None
+    assert tracing.get_tracer().spans("ghost") == []
+
+
+def test_trace_span_decorator():
+    from ray_tpu.observability import tracing
+
+    tracing.enable()
+    try:
+        @tracing.trace_span("decorated")
+        def fn(x):
+            return x + 1
+
+        assert fn(1) == 2
+        assert tracing.get_tracer().spans("decorated")
+    finally:
+        tracing.disable()
+        tracing.get_tracer().clear()
+
+
+def test_submission_spans_and_remote_context(monkeypatch):
+    """End-to-end: driver records task.submit spans; workers adopt the
+    submitted trace context so their execution joins the trace."""
+    monkeypatch.setenv("RT_TRACING_ENABLED", "1")
+    from ray_tpu.core.config import Config
+
+    Config.reset()
+    import ray_tpu as rt
+    from ray_tpu.observability import tracing
+
+    if rt.is_initialized():
+        rt.shutdown()  # don't collide with module-shared runtimes
+    rt.init(num_cpus=2)
+    try:
+        with tracing.span("driver-root"):
+            @rt.remote
+            def traced_task():
+                from ray_tpu.observability import tracing as wtr
+
+                # The worker-side execute span carries the driver's trace.
+                spans = wtr.get_tracer().spans("task.execute")
+                cur = wtr.current_span()
+                return (cur is not None, cur.trace_id if cur else None)
+
+            has_span, trace_id = rt.get(traced_task.remote())
+        assert has_span
+        root = tracing.get_tracer().spans("driver-root")[0]
+        assert trace_id == root.trace_id, "worker span must join the trace"
+        submits = tracing.get_tracer().spans("task.submit")
+        assert submits and submits[0].trace_id == root.trace_id
+    finally:
+        rt.shutdown()
+        tracing.disable()
+        tracing.get_tracer().clear()
+        Config.reset()
+
+
+# -- TPE searcher ------------------------------------------------------------
+
+def _quadratic(cfg):
+    return (cfg["x"] - 0.7) ** 2 + (cfg["y"] - 0.3) ** 2
+
+
+def test_tpe_beats_random_on_quadratic():
+    from ray_tpu.tune.search import TPESearcher, Uniform
+
+    space = {"x": Uniform(0, 1), "y": Uniform(0, 1)}
+
+    def run(searcher_factory, n=60, seed=0):
+        best = float("inf")
+        searcher = searcher_factory()
+        for i in range(n):
+            cfg = searcher.suggest(f"t{i}")
+            score = _quadratic(cfg)
+            searcher.on_trial_complete(f"t{i}", {"loss": score})
+            best = min(best, score)
+        return best
+
+    tpe_best = run(lambda: TPESearcher(space, metric="loss", mode="min",
+                                       n_startup_trials=10, seed=1))
+
+    rng = random.Random(1)
+    rand_best = min(
+        _quadratic({"x": rng.uniform(0, 1), "y": rng.uniform(0, 1)})
+        for _ in range(60))
+    # TPE should at least match pure random at equal budget (usually far
+    # better); a loose factor keeps the test seed-robust.
+    assert tpe_best <= rand_best * 1.5, (tpe_best, rand_best)
+    assert tpe_best < 0.02
+
+
+def test_tpe_handles_all_domain_types():
+    from ray_tpu.tune.search import (
+        Choice,
+        LogUniform,
+        RandInt,
+        TPESearcher,
+        Uniform,
+    )
+
+    space = {
+        "lr": LogUniform(1e-5, 1e-1),
+        "width": RandInt(8, 256),
+        "act": Choice(["relu", "tanh", "gelu"]),
+        "drop": Uniform(0.0, 0.5),
+        "fixed": 42,
+    }
+    searcher = TPESearcher(space, metric="score", mode="max",
+                           n_startup_trials=5, seed=0)
+    for i in range(20):
+        cfg = searcher.suggest(f"t{i}")
+        assert 1e-5 <= cfg["lr"] <= 1e-1
+        assert 8 <= cfg["width"] < 256
+        assert cfg["act"] in ("relu", "tanh", "gelu")
+        assert cfg["fixed"] == 42
+        searcher.on_trial_complete(f"t{i}", {"score": cfg["drop"]})
+
+
+def test_tpe_max_trials_exhausts():
+    from ray_tpu.tune.search import TPESearcher, Uniform
+
+    searcher = TPESearcher({"x": Uniform(0, 1)}, metric="loss",
+                           max_trials=3, seed=0)
+    assert all(searcher.suggest(f"t{i}") is not None for i in range(3))
+    assert searcher.suggest("t3") is None
+
+
+def test_tpe_in_tuner(rt_shared):
+    from ray_tpu.tune import Tuner
+    from ray_tpu.tune.search import TPESearcher, Uniform
+
+    def objective(config):
+        from ray_tpu.tune import report
+
+        report({"loss": (config["x"] - 0.5) ** 2})
+
+    from ray_tpu.tune import TuneConfig
+
+    searcher = TPESearcher({"x": Uniform(0, 1)}, metric="loss", mode="min",
+                           n_startup_trials=4, max_trials=10, seed=0)
+    tuner = Tuner(objective,
+                  tune_config=TuneConfig(search_alg=searcher,
+                                         max_concurrent_trials=2))
+    grid = tuner.fit()
+    best = grid.get_best_result("loss", mode="min")
+    assert best.last_result["loss"] < 0.1
+
+
+def test_actor_execution_traced(monkeypatch):
+    monkeypatch.setenv("RT_TRACING_ENABLED", "1")
+    from ray_tpu.core.config import Config
+
+    Config.reset()
+    import ray_tpu as rt
+    from ray_tpu.observability import tracing
+
+    if rt.is_initialized():
+        rt.shutdown()  # don't collide with module-shared runtimes
+    rt.init(num_cpus=2)
+    try:
+        @rt.remote
+        class Probe:
+            def look(self):
+                from ray_tpu.observability import tracing as wtr
+
+                cur = wtr.current_span()
+                return cur.name if cur else None
+
+        p = Probe.remote()
+        name = rt.get(p.look.remote())
+        assert name and name.startswith("task.execute")
+    finally:
+        rt.shutdown()
+        tracing.disable()
+        tracing.get_tracer().clear()
+        Config.reset()
+
+
+def test_tpe_zero_startup_does_not_crash():
+    from ray_tpu.tune.search import TPESearcher, Uniform
+
+    s = TPESearcher({"x": Uniform(0, 1)}, metric="loss",
+                    n_startup_trials=0, seed=0)
+    cfg = s.suggest("t0")  # empty history must fall back to random
+    assert 0 <= cfg["x"] <= 1
+
+
+def test_tpe_rejects_grid_search():
+    import pytest as _pytest
+
+    from ray_tpu.tune.search import GridSearch, TPESearcher
+
+    with _pytest.raises(ValueError):
+        TPESearcher({"bs": GridSearch([32, 64])}, metric="loss")
