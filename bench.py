@@ -25,14 +25,19 @@ def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from ray_tpu.models import gpt2
     from ray_tpu.parallel.mesh import MeshSpec
+    from ray_tpu.train.optim import adamw_lowmem
     from ray_tpu.train.step import build_sharded_train, default_optimizer
 
     on_tpu = jax.default_backend() == "tpu"
     n_dev = len(jax.devices())
 
     if on_tpu:
-        model_name = os.environ.get("BENCH_MODEL", "gpt2-355m")
-        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        # Largest config that fits one 16GB v5e chip with bf16 Adam
+        # moments + fp32 master + "mem" remat + chunked CE (1.5B needs
+        # ≥18.6GB of param/opt state alone — see dryrun_multichip for its
+        # fsdp-sharded compile check).
+        model_name = os.environ.get("BENCH_MODEL", "gpt2-774m")
+        batch = int(os.environ.get("BENCH_BATCH", "6"))
         seq = int(os.environ.get("BENCH_SEQ", "1024"))
         steps = int(os.environ.get("BENCH_STEPS", "10"))
         peak_flops_per_chip = 197e12  # v5e bf16
@@ -49,9 +54,12 @@ def main():
         num_heads=base_cfg.num_heads,
         d_model=base_cfg.d_model,
         dtype=jnp.bfloat16,
-        attention_impl="flash" if on_tpu else "reference",
+        attention_impl=os.environ.get(
+            "BENCH_ATTN", "flash" if on_tpu else "reference"),
         remat=True,
-        remat_policy=os.environ.get("BENCH_REMAT", "dots_attn"),
+        remat_policy=os.environ.get(
+            "BENCH_REMAT", "mem" if on_tpu else "dots_attn"),
+        scan_unroll=int(os.environ.get("BENCH_UNROLL", "1")),
     )
 
     mesh = MeshSpec(dp=n_dev).build()
@@ -60,9 +68,19 @@ def main():
     def loss_fn(params, batch_):
         return gpt2.loss_fn(params, batch_, cfg)
 
+    # bf16 Adam moments (fp32 math) halve optimizer-state HBM — the
+    # difference between 774M fitting one 16GB chip or not.
+    if os.environ.get("BENCH_OPT", "lowmem") == "lowmem":
+        import optax
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, 1e-4, 100, 1000, end_value=1e-5)
+        optimizer = adamw_lowmem(schedule)
+    else:
+        optimizer = default_optimizer(lr=1e-4, total_steps=1000)
+
     sinit, sstep, _ = build_sharded_train(
-        init_fn, loss_fn, mesh,
-        optimizer=default_optimizer(lr=1e-4, total_steps=1000),
+        init_fn, loss_fn, mesh, optimizer=optimizer,
+        master_fp32=os.environ.get("BENCH_MASTER", "1") == "1",
     )
     params, opt_state, step = sinit(jax.random.PRNGKey(0))
 

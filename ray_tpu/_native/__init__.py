@@ -20,12 +20,23 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+def _stale(artifact: str, *sources: str) -> bool:
+    """True if the artifact is missing or older than any of its sources."""
+    if not os.path.exists(artifact):
+        return True
+    mtime = os.path.getmtime(artifact)
+    return any(
+        os.path.exists(src) and os.path.getmtime(src) > mtime
+        for src in sources
+    )
+
+
 def _load_lib():
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
+        if _stale(_LIB_PATH, os.path.join(_HERE, "shm_store.cc")):
             try:
                 subprocess.run(
                     ["make", "-C", _HERE], check=True,
@@ -85,6 +96,11 @@ class NativeStoreFull(NativeStoreError):
     pass
 
 
+class NativeStorePendingDelete(NativeStoreError):
+    """Key was deleted while readers still pin the old extent; a new put
+    for the same key must wait until the last reader releases."""
+
+
 class NativeStore:
     """One arena per node; create in the node manager, attach in workers."""
 
@@ -119,6 +135,8 @@ class NativeStore:
             raise NativeStoreFull("arena full")
         if rc == -3:
             raise NativeStoreError("object table full")
+        if rc == -5:
+            raise NativeStorePendingDelete(key.hex())
         if rc != 0:
             raise NativeStoreError(f"put failed rc={rc}")
 
@@ -141,7 +159,10 @@ class NativeStore:
         return bool(self._lib.rt_store_contains(self._handle, key))
 
     def delete(self, key: bytes) -> bool:
-        return self._lib.rt_store_delete(self._handle, key) == 0
+        """True when the object existed. The extent free may be deferred
+        until the last pinned reader releases (rc 1); either way the key
+        stops being gettable immediately."""
+        return self._lib.rt_store_delete(self._handle, key) >= 0
 
     def stats(self) -> dict:
         cap = ctypes.c_uint64()

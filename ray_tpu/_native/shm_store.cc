@@ -37,14 +37,16 @@ enum SlotState : uint32_t {
   SLOT_CREATED = 1,  // allocated, being written
   SLOT_SEALED = 2,   // immutable, readable
   SLOT_TOMBSTONE = 3,
+  SLOT_PENDING_DELETE = 4,  // deleted while pinned; freed on last release
 };
 
 struct Slot {
   uint8_t key[kKeySize];
   uint32_t state;
-  uint64_t offset;  // into data arena
-  uint64_t size;
-  int64_t refcount;  // pin count from readers
+  uint64_t offset;      // into data arena
+  uint64_t size;        // logical object size
+  uint64_t alloc_size;  // actual extent charged by arena_alloc (>= size)
+  int64_t refcount;     // pin count from readers
 };
 
 struct FreeBlock {
@@ -115,8 +117,10 @@ Slot* find_slot(Store* s, const uint8_t* key, bool for_insert) {
 }
 
 // First-fit allocation from the in-arena free list. Returns arena-relative
-// offset or UINT64_MAX. Caller holds the mutex.
-uint64_t arena_alloc(Store* s, uint64_t size) {
+// offset or UINT64_MAX; *actual_out receives the extent actually charged
+// (aligned size, possibly grown by an absorbed sliver) — the caller must
+// pass exactly this value back to arena_free. Caller holds the mutex.
+uint64_t arena_alloc(Store* s, uint64_t size, uint64_t* actual_out) {
   StoreHeader* h = header(s);
   size = align_up(size);
   uint64_t prev_off = 0;  // 0 = head pointer itself
@@ -141,6 +145,7 @@ uint64_t arena_alloc(Store* s, uint64_t size) {
         reinterpret_cast<FreeBlock*>(arena(s) + (prev_off - 1))->next = next;
       }
       h->used_bytes += size;
+      *actual_out = size;
       return cur - 1;
     }
     prev_off = cur;
@@ -149,11 +154,10 @@ uint64_t arena_alloc(Store* s, uint64_t size) {
   return UINT64_MAX;
 }
 
-// Return an extent to the free list, coalescing with neighbors.
-// Caller holds the mutex.
+// Return an extent to the free list, coalescing with neighbors. `size` must
+// be the exact alloc_size recorded at allocation time. Caller holds the mutex.
 void arena_free(Store* s, uint64_t offset, uint64_t size) {
   StoreHeader* h = header(s);
-  size = align_up(size);
   h->used_bytes -= size;
   // Insert sorted by offset, then coalesce.
   uint64_t prev_off = 0;
@@ -269,13 +273,18 @@ static int lock_robust(StoreHeader* h) {
 }
 
 // Allocate + copy + seal in one call. Returns 0 ok, -1 exists, -2 full,
-// -3 table full, -4 error.
+// -3 table full, -4 error, -5 key is pending-delete (old extent still
+// pinned by readers; retry after they release).
 int rt_store_put(void* handle, const uint8_t* key, const uint8_t* data,
                  uint64_t size) {
   Store* s = static_cast<Store*>(handle);
   StoreHeader* h = header(s);
   if (lock_robust(h) != 0) return -4;
   Slot* existing = find_slot(s, key, false);
+  if (existing && existing->state == SLOT_PENDING_DELETE) {
+    pthread_mutex_unlock(&h->mutex);
+    return -5;
+  }
   if (existing && existing->state == SLOT_SEALED) {
     pthread_mutex_unlock(&h->mutex);
     return -1;
@@ -285,7 +294,8 @@ int rt_store_put(void* handle, const uint8_t* key, const uint8_t* data,
     pthread_mutex_unlock(&h->mutex);
     return -3;
   }
-  uint64_t off = arena_alloc(s, size ? size : 1);
+  uint64_t actual = 0;
+  uint64_t off = arena_alloc(s, size ? size : 1, &actual);
   if (off == UINT64_MAX) {
     pthread_mutex_unlock(&h->mutex);
     return -2;
@@ -293,6 +303,7 @@ int rt_store_put(void* handle, const uint8_t* key, const uint8_t* data,
   memcpy(slot->key, key, kKeySize);
   slot->offset = off;
   slot->size = size;
+  slot->alloc_size = actual;
   slot->refcount = 0;
   memcpy(arena(s) + off, data, size);
   slot->state = SLOT_SEALED;
@@ -309,11 +320,13 @@ uint8_t* rt_store_create_object(void* handle, const uint8_t* key,
   StoreHeader* h = header(s);
   if (lock_robust(h) != 0) return nullptr;
   Slot* slot = find_slot(s, key, true);
-  if (!slot || slot->state == SLOT_SEALED) {
+  if (!slot || slot->state == SLOT_SEALED ||
+      slot->state == SLOT_PENDING_DELETE) {
     pthread_mutex_unlock(&h->mutex);
     return nullptr;
   }
-  uint64_t off = arena_alloc(s, size ? size : 1);
+  uint64_t actual = 0;
+  uint64_t off = arena_alloc(s, size ? size : 1, &actual);
   if (off == UINT64_MAX) {
     pthread_mutex_unlock(&h->mutex);
     return nullptr;
@@ -321,6 +334,7 @@ uint8_t* rt_store_create_object(void* handle, const uint8_t* key,
   memcpy(slot->key, key, kKeySize);
   slot->offset = off;
   slot->size = size;
+  slot->alloc_size = actual;
   slot->refcount = 0;
   slot->state = SLOT_CREATED;
   pthread_mutex_unlock(&h->mutex);
@@ -366,7 +380,13 @@ int rt_store_release(void* handle, const uint8_t* key) {
   StoreHeader* h = header(s);
   if (lock_robust(h) != 0) return -4;
   Slot* slot = find_slot(s, key, false);
-  if (slot && slot->refcount > 0) slot->refcount--;
+  if (slot && slot->refcount > 0) {
+    slot->refcount--;
+    if (slot->refcount == 0 && slot->state == SLOT_PENDING_DELETE) {
+      arena_free(s, slot->offset, slot->alloc_size);
+      slot->state = SLOT_TOMBSTONE;
+    }
+  }
   pthread_mutex_unlock(&h->mutex);
   return 0;
 }
@@ -381,21 +401,32 @@ int rt_store_contains(void* handle, const uint8_t* key) {
   return ok;
 }
 
-// Delete (even if pinned — single-host trust model; caller coordinates).
+// Delete. If readers still pin the object (zero-copy views in other
+// processes), the extent free is deferred until the last rt_store_release —
+// the slot moves to PENDING_DELETE and stops being gettable immediately.
+// Returns 0 when the extent was freed now, 1 when the free was deferred,
+// -1 when the key does not exist.
 int rt_store_delete(void* handle, const uint8_t* key) {
   Store* s = static_cast<Store*>(handle);
   StoreHeader* h = header(s);
   if (lock_robust(h) != 0) return -4;
   Slot* slot = find_slot(s, key, false);
-  if (!slot || slot->state == SLOT_FREE) {
+  if (!slot || slot->state == SLOT_FREE ||
+      slot->state == SLOT_PENDING_DELETE) {
     pthread_mutex_unlock(&h->mutex);
     return -1;
   }
-  arena_free(s, slot->offset, slot->size ? slot->size : 1);
-  slot->state = SLOT_TOMBSTONE;
+  int deferred = 0;
+  if (slot->refcount > 0) {
+    slot->state = SLOT_PENDING_DELETE;
+    deferred = 1;
+  } else {
+    arena_free(s, slot->offset, slot->alloc_size);
+    slot->state = SLOT_TOMBSTONE;
+  }
   h->num_objects--;
   pthread_mutex_unlock(&h->mutex);
-  return 0;
+  return deferred;
 }
 
 void rt_store_stats(void* handle, uint64_t* capacity, uint64_t* used,

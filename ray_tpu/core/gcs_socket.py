@@ -303,8 +303,10 @@ class _Subscriber:
 
 
 def build_native() -> bool:
-    """Build the daemon binary if missing; True when available."""
-    if os.path.exists(_BINARY):
+    """Build the daemon binary if missing or stale; True when available."""
+    from .._native import _stale
+
+    if not _stale(_BINARY, os.path.join(_NATIVE_DIR, "control_store.cc")):
         return True
     try:
         subprocess.run(["make", "-C", _NATIVE_DIR], check=True,

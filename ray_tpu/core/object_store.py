@@ -111,7 +111,7 @@ class SharedMemoryStore:
             self._ensure_capacity(size)
             backend = "segment"
             if self._arena is not None:
-                self._arena.put(object_id.binary(), frame)
+                self._arena_put_retrying(object_id, frame)
                 backend = "arena"
             else:
                 seg = shared_memory.SharedMemory(
@@ -159,7 +159,11 @@ class SharedMemoryStore:
                 raise ObjectLostError(object_id)
             meta.last_access = time.monotonic()
             if meta.spilled_path is not None:
-                self._restore(meta)
+                frame = self._restore(meta)
+                if frame is not None:
+                    # Old extent still pinned by a stale reader; serve the
+                    # spill-file bytes directly (file remains on disk).
+                    return memoryview(frame)
             if meta.backend == "arena" and self._arena is not None:
                 view = self._arena.get(object_id.binary())
                 if view is None:
@@ -207,13 +211,24 @@ class SharedMemoryStore:
             if meta.spilled_path and os.path.exists(meta.spilled_path):
                 os.unlink(meta.spilled_path)
 
+    def _used_now(self) -> int:
+        """Live occupancy. For the arena backend ask the allocator itself:
+        it is the truth for deferred frees (delete-while-pinned) and
+        absorbed-sliver padding that logical accounting can't see."""
+        if self._arena is not None:
+            try:
+                return self._arena.stats()["used_bytes"]
+            except Exception:
+                pass
+        return self.used
+
     def _ensure_capacity(self, need: int) -> None:
         if need > self.capacity:
             raise ObjectStoreFullError(
                 f"object of {need} bytes exceeds store capacity {self.capacity}"
             )
         threshold = config().object_spilling_threshold
-        if self.used + need <= self.capacity * threshold:
+        if self._used_now() + need <= self.capacity * threshold:
             return
         # Spill least-recently-accessed unpinned objects until there is room
         # (reference: LocalObjectManager::SpillObjects, fused to min size).
@@ -223,12 +238,13 @@ class SharedMemoryStore:
             key=lambda m: m.last_access,
         )
         for meta in candidates:
-            if self.used + need <= self.capacity * threshold:
+            if self._used_now() + need <= self.capacity * threshold:
                 break
             self._spill(meta)
-        if self.used + need > self.capacity:
+        if self._used_now() + need > self.capacity:
             raise ObjectStoreFullError(
-                f"need {need} bytes; used {self.used}/{self.capacity} after spilling"
+                f"need {need} bytes; used {self._used_now()}/"
+                f"{self.capacity} after spilling"
             )
 
     def _spill(self, meta: ObjectMeta) -> None:
@@ -251,14 +267,50 @@ class SharedMemoryStore:
         meta.spilled_path = path
         self.used -= meta.size
 
-    def _restore(self, meta: ObjectMeta) -> None:
+    def _arena_put_retrying(self, object_id: ObjectID, frame: bytes) -> None:
+        """Arena put that spills harder and retries once when the arena is
+        fuller than logical accounting suggested (deferred frees,
+        fragmentation), rather than leaking NativeStoreFull to callers."""
+        from .._native import NativeStoreFull
+
+        try:
+            self._arena.put(object_id.binary(), frame)
+            return
+        except NativeStoreFull:
+            pass
+        for meta in sorted(
+                (m for m in self._meta.values()
+                 if m.pinned == 0 and m.spilled_path is None
+                 and m.object_id != object_id),
+                key=lambda m: m.last_access):
+            self._spill(meta)
+            try:
+                self._arena.put(object_id.binary(), frame)
+                return
+            except NativeStoreFull:
+                continue
+        raise ObjectStoreFullError(
+            f"arena full putting {len(frame)} bytes "
+            f"(used {self._used_now()}/{self.capacity})")
+
+    def _restore(self, meta: ObjectMeta) -> bytes | None:
+        """Bring a spilled object back. Returns the raw frame when the
+        object could NOT be re-admitted to shared memory (its key is
+        pending-delete: a stale reader still pins the old extent) — the
+        caller serves those bytes directly and the spill file stays as
+        the durable copy."""
+        from .._native import NativeStorePendingDelete
+
         path = meta.spilled_path
         assert path is not None
         with open(path, "rb") as f:
             frame = f.read()
         self._ensure_capacity(len(frame))
         if meta.backend == "arena" and self._arena is not None:
-            self._arena.put(meta.object_id.binary(), frame)
+            try:
+                self._arena.put(meta.object_id.binary(), frame)
+            except NativeStorePendingDelete:
+                return frame
         else:
             seg = shared_memory.SharedMemory(
                 create=True, size=max(len(frame), 1),
@@ -269,6 +321,7 @@ class SharedMemoryStore:
         self.used += meta.size
         meta.spilled_path = None
         os.unlink(path)
+        return None
 
     def destroy(self) -> None:
         """Tear down all segments (node death / shutdown)."""

@@ -56,13 +56,19 @@ def rms_norm(x, scale, eps: float = 1e-6):
     )
 
 
-def cross_entropy_loss(logits, targets, ignore_id: int = -1):
-    """Token-level CE in fp32; returns (mean_loss, denom)."""
+def cross_entropy_sums(logits, targets, ignore_id: int = -1):
+    """Masked token CE in fp32 as (nll_sum, token_count) — the composable
+    form, summable across sequence/loss chunks."""
     logits = logits.astype(jnp.float32)
     mask = (targets != ignore_id).astype(jnp.float32)
     targets = jnp.maximum(targets, 0)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = (logz - gold) * mask
-    denom = jnp.maximum(mask.sum(), 1.0)
-    return nll.sum() / denom, denom
+    return jnp.sum((logz - gold) * mask), mask.sum()
+
+
+def cross_entropy_loss(logits, targets, ignore_id: int = -1):
+    """Token-level CE in fp32; returns (mean_loss, denom)."""
+    nll_sum, count = cross_entropy_sums(logits, targets, ignore_id)
+    denom = jnp.maximum(count, 1.0)
+    return nll_sum / denom, denom

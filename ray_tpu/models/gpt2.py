@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import attention as attention_op
 from ..parallel.sharding import constrain
-from .common import cross_entropy_loss, layer_norm, truncated_normal
+from .common import cross_entropy_sums, layer_norm, truncated_normal
 
 
 @dataclass(frozen=True)
@@ -47,6 +47,9 @@ class GPT2Config:
     # trade (much better MFU, modestly more memory); "none" disables.
     remat_policy: str = "dots"
     scan_layers: bool = True
+    # Unrolling the layer scan trades compile time for per-iteration
+    # while-loop overhead (XLA sequencing + carry copies per step).
+    scan_unroll: int = 1
     sp_axis: str = "sp"
 
     @property
@@ -174,9 +177,12 @@ def _block(x, p, cfg: GPT2Config, rules):
     b, s, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
 
+    from jax.ad_checkpoint import checkpoint_name
+
     y = layer_norm(x, p["ln1_scale"], p["ln1_bias"])
     qkv = (y @ p["qkv_w"].astype(y.dtype)) + p["qkv_b"].astype(y.dtype)
     qkv = constrain(qkv, ("batch", "seq", "qkv"), rules)
+    qkv = checkpoint_name(qkv, "qkv")
     q, k, v = jnp.split(qkv, 3, axis=-1)
 
     def heads(t):  # [B,S,D] -> [B,H,S,hd]
@@ -190,6 +196,7 @@ def _block(x, p, cfg: GPT2Config, rules):
     y = layer_norm(x, p["ln2_scale"], p["ln2_bias"])
     hdn = (y @ p["mlp_in_w"].astype(y.dtype)) + p["mlp_in_b"].astype(y.dtype)
     hdn = constrain(hdn, ("batch", "seq", "mlp"), rules)
+    hdn = checkpoint_name(hdn, "mlp_in")
     hdn = jax.nn.gelu(hdn, approximate=True)
     out = (hdn @ p["mlp_out_w"].astype(hdn.dtype)) + p["mlp_out_b"].astype(
         hdn.dtype
@@ -197,11 +204,41 @@ def _block(x, p, cfg: GPT2Config, rules):
     return x + constrain(out, ("batch", "seq", None), rules)
 
 
-def forward(params, tokens, cfg: GPT2Config, rules=None):
-    """tokens [B, S] -> logits [B, S, vocab]."""
+def _embed_lookup(wte, tokens, rules):
+    """Token-embedding gather, partitioned by the INDICES (batch/seq).
+
+    GSPMD insists on partitioning a table gather along the embed (offset)
+    dim and then pays an involuntary full-rematerialization reshard to the
+    activation layout. A shard_map pins the data-parallel decomposition:
+    replicated table, (batch, seq)-sharded indices, purely local gathers.
+    """
+    from ..parallel.sharding import current_mesh, smap, spec_for
+    from jax.sharding import PartitionSpec as P
+
+    mesh = current_mesh()
+    idx_spec = spec_for(("batch", "seq"), rules)
+    if mesh is None or idx_spec == P(None, None):
+        return wte[tokens]
+    out_spec = spec_for(("batch", "seq", None), rules)
+    lookup = smap(lambda w, t: w[t], mesh,
+                  in_specs=(P(), idx_spec), out_specs=out_spec)
+    return lookup(wte, tokens)
+
+
+def forward_features(params, tokens, cfg: GPT2Config, rules=None):
+    """tokens [B, S] -> final hidden states [B, S, D] (pre LM head)."""
     b, s = tokens.shape
-    x = params["wte"][tokens].astype(cfg.dtype)
-    x = x + params["wpe"][:s].astype(cfg.dtype)[None]
+    # The embedding table is stored vocab/embed-sharded (tp/fsdp) for the
+    # LM head matmul; a gather over a sharded table forces GSPMD into
+    # involuntary full rematerialization of the output. Constrain the
+    # lookup operand to fully replicated (one explicit all-gather, same
+    # cost class as an fsdp weight gather): with indices sharded over
+    # (batch, seq) the gather is then local and its output is ALREADY in
+    # the activation sharding — no resharding transition at all.
+    wte = constrain(params["wte"], (None, None), rules)
+    wpe = constrain(params["wpe"], (None, None), rules)
+    x = _embed_lookup(wte, tokens, rules)
+    x = x.astype(cfg.dtype) + wpe[:s].astype(cfg.dtype)[None]
     x = constrain(x, ("batch", "seq", None), rules)
 
     block = partial(_block, cfg=cfg, rules=rules)
@@ -216,6 +253,23 @@ def forward(params, tokens, cfg: GPT2Config, rules=None):
                     "attn_out", "attn_lse"),
             )
             block = jax.checkpoint(block, policy=policy)
+        elif cfg.remat_policy == "mem":
+            # Save only the three big matmul outputs the backward pass
+            # actually consumes (qkv feeds flash dq/dkv, attn_out feeds
+            # proj bwd, pre-gelu mlp_in feeds gelu bwd). Residual-branch
+            # outputs (proj/mlp_out) are recomputed — one extra d×d matmul
+            # per block (~3% step FLOPs) for ~25% less activation HBM,
+            # which is what fits 774M at batch 8 on a 16GB chip.
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "qkv", "attn_out", "attn_lse", "mlp_in")
+            block = jax.checkpoint(block, policy=policy)
+        elif cfg.remat_policy == "mem2":
+            # Leanest: drop mlp_in too (recomputed by re-running the
+            # mlp_in matmul in backward, ~+1/6 fwd matmul FLOPs) —
+            # fits 774M at batch 8 / 1.5B at batch 2 on a 16GB chip.
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "qkv", "attn_out", "attn_lse")
+            block = jax.checkpoint(block, policy=policy)
         else:
             block = jax.checkpoint(block)
 
@@ -223,13 +277,20 @@ def forward(params, tokens, cfg: GPT2Config, rules=None):
         def scan_body(x, layer_params):
             return block(x, layer_params), None
 
-        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+        x, _ = jax.lax.scan(scan_body, x, params["blocks"],
+                            unroll=cfg.scan_unroll)
     else:
         for i in range(cfg.num_layers):
             layer = jax.tree.map(lambda a: a[i], params["blocks"])
             x = block(x, layer)
 
     x = layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    return x
+
+
+def forward(params, tokens, cfg: GPT2Config, rules=None):
+    """tokens [B, S] -> logits [B, S, vocab]."""
+    x = forward_features(params, tokens, cfg, rules)
     # Tied LM head (fp32 logits for a stable loss).
     logits = jnp.einsum(
         "bsd,vd->bsv", x, params["wte"].astype(cfg.dtype),
@@ -238,13 +299,53 @@ def forward(params, tokens, cfg: GPT2Config, rules=None):
     return constrain(logits, ("batch", "seq", "vocab"), rules)
 
 
-def loss_fn(params, batch, cfg: GPT2Config, rules=None):
-    """batch: {"tokens": [B, S+1]} → next-token CE loss."""
+def loss_fn(params, batch, cfg: GPT2Config, rules=None,
+            loss_chunk: int = 4096):
+    """batch: {"tokens": [B, S+1]} → next-token CE loss.
+
+    The LM head + CE run in token chunks under ``jax.checkpoint``: fp32
+    logits for the full batch are B*S*vocab*4 bytes (1.65GB at 774M batch
+    8) and the CE backward doubles that — chunking caps the live logits
+    footprint at chunk*vocab*4*2 and recomputes the chunk's head matmul
+    in backward (~2.5% extra FLOPs), which is what lets the large-batch
+    configs fit one chip.
+    """
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inputs, cfg, rules)
-    loss, _ = cross_entropy_loss(logits, targets)
-    return loss
+    x = forward_features(params, inputs, cfg, rules)
+    d = x.shape[-1]
+    wte = params["wte"].astype(cfg.dtype)
+
+    xf = x.reshape(-1, d)
+    tf = targets.reshape(-1)
+    n = xf.shape[0]
+    # Even chunks (rounded to 256 lanes) minimize padding waste: e.g.
+    # 6138 tokens → 2×3072 (0.1% pad) instead of 2×4096 (33% pad).
+    n_chunks = max(1, -(-n // loss_chunk))
+    per_chunk = -(-n // n_chunks)
+    chunk = min(n, -(-per_chunk // 256) * 256) if n >= 256 else n
+    pad = (-n) % chunk
+    if pad:
+        xf = jnp.pad(xf, ((0, pad), (0, 0)))
+        tf = jnp.pad(tf, (0, pad), constant_values=-1)  # ignore_id
+    n_chunks = xf.shape[0] // chunk
+    xc = xf.reshape(n_chunks, chunk, d)
+    tc = tf.reshape(n_chunks, chunk)
+
+    @jax.checkpoint
+    def chunk_loss(carry, xt):
+        xi, ti = xt
+        logits = jax.lax.dot_general(
+            xi, wte, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        nll, count = cross_entropy_sums(logits, ti)
+        nll_sum, denom = carry
+        return (nll_sum + nll, denom + count), None
+
+    (nll_sum, denom), _ = jax.lax.scan(
+        chunk_loss, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (xc, tc))
+    return nll_sum / jnp.maximum(denom, 1.0)
 
 
 def flops_per_token(cfg: GPT2Config, seq: int) -> float:

@@ -5,14 +5,20 @@ there); this framework ships them because long-context SP/ring attention is
 first-class (SURVEY §5.7). Design follows the standard online-softmax flash
 algorithm, tiled for the MXU:
 
-  - grid over (batch*heads, query blocks)
-  - K/V stream through VMEM in ``block_k`` chunks with running (m, l, acc)
+  - grid over (batch, query blocks) with ALL heads processed inside each
+    program. At LM training shapes (head_dim 64, seq ~1-8k) the per-head
+    tile work is far smaller than Mosaic's per-program overhead, so a
+    (batch*heads, q-blocks) grid spends most of its time sequencing; head
+    folding raises per-program work ~H× and measured ~4-5× kernel speedup
+  - K/V resident in VMEM per program, streamed in ``block_k`` chunks with
+    running (m, l, acc) online softmax
   - causal masking skips fully-masked K blocks (block-level early exit)
-  - bf16 inputs, fp32 accumulation (``preferred_element_type``)
+  - bf16 matmul operands, fp32 accumulation (``preferred_element_type``)
 
-``flash_attention`` is differentiable: forward = Pallas kernel, backward =
-blockwise recompute in XLA (flash-style memory footprint, no S×S
-materialization).
+``flash_attention`` is differentiable end-to-end in Pallas: forward kernel
+plus dq and dk/dv backward kernels (blockwise recompute from the saved LSE
+— no S×S materialization anywhere). An XLA blockwise fallback covers
+shapes the kernels can't tile.
 """
 
 from __future__ import annotations
@@ -64,62 +70,134 @@ def mha_reference(q, k, v, causal: bool = True,
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
+def _causal_upper(qi, block_q: int, block_k: int, num_kb: int):
+    """Number of K blocks the online-softmax loop must visit for Q block
+    ``qi`` under causal masking (blocks past the diagonal are all-masked)."""
+    upper = jnp.minimum(
+        num_kb, (qi + 1) * block_q // block_k + (block_q // block_k == 0)
+    )
+    return jnp.maximum(upper, 1)
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                       *, block_k: int, seq_k: int, scale: float,
-                      causal: bool, block_q: int):
+                      causal: bool, block_q: int, num_heads: int):
     from jax.experimental import pallas as pl
 
-    qi = pl.program_id(1)
-    # CRITICAL for MXU throughput: matmul operands stay in bf16 — only the
-    # accumulator is fp32 (preferred_element_type). Casting inputs to fp32
-    # first would push the dots off the fast MXU path (~8x slower).
-    q = q_ref[0]  # [block_q, D], input dtype
-    d = q.shape[-1]
-
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-
+    qi = pl.program_id(2)
     num_kb = seq_k // block_k
-    if causal:
-        # Only K blocks at or before this Q block's diagonal contribute.
-        upper = jnp.minimum(
-            num_kb, (qi + 1) * block_q // block_k + (block_q // block_k == 0)
-        )
-        upper = jnp.maximum(upper, 1)
-    else:
-        upper = num_kb
-
+    upper = _causal_upper(qi, block_q, block_k, num_kb) if causal else num_kb
     q_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    d = q_ref.shape[-1]
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [block_q, block_k] fp32
-        if causal:
-            k_pos = (
-                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-                + kb * block_k
+    def head_body(hh, _):
+        # CRITICAL for MXU throughput: matmul operands stay in bf16 — only
+        # the accumulator is fp32 (preferred_element_type). Casting inputs
+        # to fp32 first pushes the dots off the fast MXU path (~8x slower).
+        q = q_ref[0, hh]  # [block_q, D], input dtype
+
+        m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((block_q, 1), jnp.float32)
+        acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+        def body(kb, carry):
+            m, l, acc = carry
+            k_blk = k_ref[0, hh, pl.ds(kb * block_k, block_k), :]
+            v_blk = v_ref[0, hh, pl.ds(kb * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [block_q, block_k] fp32
+            if causal:
+                k_pos = (
+                    jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 1)
+                    + kb * block_k
+                )
+                s = jnp.where(q_pos + qi * block_q >= k_pos, s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * alpha + jax.lax.dot_general(
+                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-            s = jnp.where(q_pos + qi * block_q >= k_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return m_new, l_new, acc_new
+            return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    safe_l = jnp.where(l == 0, 1.0, l)
-    o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(safe_l)  # [block_q, 1]
+        m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+        safe_l = jnp.where(l == 0, 1.0, l)
+        o_ref[0, hh] = (acc / safe_l).astype(o_ref.dtype)
+        lse_ref[0, hh] = m + jnp.log(safe_l)  # [block_q, 1]
+        return 0
+
+    jax.lax.fori_loop(0, num_heads, head_body, 0)
+
+
+# Per-program VMEM budget for choosing how many heads to fold into one
+# program (v5e/v4 have 128MB VMEM; leave ample headroom for double
+# buffering + the score tile + compiler temps).
+_VMEM_BUDGET = 48 * 1024 * 1024
+_VMEM_LIMIT = 110 * 1024 * 1024
+
+
+def _pick_head_block(h: int, per_head_bytes: int) -> int:
+    """Largest divisor of ``h`` whose folded working set fits the budget."""
+    hb = h
+    while hb > 1 and (hb * per_head_bytes > _VMEM_BUDGET or h % hb != 0):
+        hb -= 1
+    while h % hb != 0:
+        hb -= 1
+    return max(hb, 1)
+
+
+def _compiler_params(interpret: bool):
+    if interpret:
+        return None
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+
+
+def _flash_fwd_single_pass_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                                  *, seq_k: int, scale: float, causal: bool,
+                                  block_q: int, num_heads: int):
+    """Short-sequence forward: the whole K/V fits VMEM, so compute the full
+    [block_q, seq_k] score tile with ONE dot and a single softmax pass —
+    no online-softmax carry chain (whose per-K-block VPU rescales dominate
+    at seq ~1k where there are only 1-2 K blocks anyway)."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, seq_k), 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, seq_k), 1)
+
+    def head_body(hh, _):
+        q = q_ref[0, hh]          # [block_q, d]
+        k = k_ref[0, hh]          # [seq_k, d]
+        v = v_ref[0, hh]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = jnp.where(q_pos + qi * block_q >= k_pos, s, _NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        o = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        safe_l = jnp.where(l == 0, 1.0, l)
+        o_ref[0, hh] = (o / safe_l).astype(o_ref.dtype)
+        lse_ref[0, hh] = m + jnp.log(safe_l)
+        return 0
+
+    jax.lax.fori_loop(0, num_heads, head_body, 0)
+
+
+# Below this K length the single-pass forward kernel (full score tile in
+# VMEM) wins over the online-softmax loop.
+_SINGLE_PASS_MAX_SK = 2048
 
 
 def _flash_fwd_pallas(q, k, v, causal: bool, scale: float,
@@ -128,30 +206,37 @@ def _flash_fwd_pallas(q, k, v, causal: bool, scale: float,
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bh = b * h
-    q3 = q.reshape(bh, sq, d)
-    k3 = k.reshape(bh, sk, d)
-    v3 = v.reshape(bh, sk, d)
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    grid = (bh, sq // block_q)
+    esize = q.dtype.itemsize
+    # q + o blocks, full-seq k + v, lse; ×2 for pipeline double-buffering.
+    per_head = 2 * (2 * block_q * d * esize + 2 * sk * d * esize
+                    + 4 * block_q)
+    hb = _pick_head_block(h, per_head)
+    grid = (b, h // hb, sq // block_q)
 
-    kernel = functools.partial(
-        _flash_fwd_kernel, block_k=block_k, seq_k=sk, scale=scale,
-        causal=causal, block_q=block_q,
-    )
+    if sk <= _SINGLE_PASS_MAX_SK:
+        kernel = functools.partial(
+            _flash_fwd_single_pass_kernel, seq_k=sk, scale=scale,
+            causal=causal, block_q=block_q, num_heads=hb,
+        )
+    else:
+        kernel = functools.partial(
+            _flash_fwd_kernel, block_k=block_k, seq_k=sk, scale=scale,
+            causal=causal, block_q=block_q, num_heads=hb,
+        )
     out_shape = [
-        jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
     ]
     in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, hb, block_q, d), lambda i, g, j: (i, g, j, 0)),
+        pl.BlockSpec((1, hb, sk, d), lambda i, g, j: (i, g, 0, 0)),
+        pl.BlockSpec((1, hb, sk, d), lambda i, g, j: (i, g, 0, 0)),
     ]
     out_specs = [
-        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, hb, block_q, d), lambda i, g, j: (i, g, j, 0)),
+        pl.BlockSpec((1, hb, block_q, 1), lambda i, g, j: (i, g, j, 0)),
     ]
     o, lse = pl.pallas_call(
         kernel,
@@ -160,8 +245,9 @@ def _flash_fwd_pallas(q, k, v, causal: bool, scale: float,
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=interpret,
-    )(q3, k3, v3)
-    return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+        compiler_params=_compiler_params(interpret),
+    )(q, k, v)
+    return o, lse.reshape(b, h, sq)
 
 
 # ---------------------------------------------------------------------------
@@ -170,106 +256,79 @@ def _flash_fwd_pallas(q, k, v, causal: bool, scale: float,
 # them to HBM, which dominates attention cost at training shapes.
 # ---------------------------------------------------------------------------
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_k: int, seq_k: int, scale: float,
-                         causal: bool, block_q: int):
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                            dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                            block_q: int, block_k: int, seq_q: int,
+                            seq_k: int, scale: float, causal: bool,
+                            num_heads: int):
+    """dq + dk + dv in ONE pallas program (per (batch, head-group)).
+
+    Every pallas_call costs a large fixed launch overhead on TPU relative
+    to this kernel's work, so the two classic backward kernels (dq gridded
+    over Q blocks, dk/dv gridded over K blocks) are fused: one program
+    walks Q blocks, recomputes P per (Q,K) tile from the saved LSE, and
+    accumulates dk/dv into fp32 VMEM scratch across the Q loop.
+    """
     from jax.experimental import pallas as pl
-
-    qi = pl.program_id(1)
-    q = q_ref[0]            # [bq, d] input dtype
-    do = do_ref[0]          # [bq, d]
-    lse = lse_ref[0]        # [bq, 1] fp32
-    delta = delta_ref[0]    # [bq, 1] fp32
-    d = q.shape[-1]
-
-    num_kb = seq_k // block_k
-    if causal:
-        upper = jnp.minimum(
-            num_kb, (qi + 1) * block_q // block_k + (block_q // block_k == 0)
-        )
-        upper = jnp.maximum(upper, 1)
-    else:
-        upper = num_kb
-
-    q_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-
-    def body(kb, dq_acc):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            k_pos = (jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1) + kb * block_k)
-            s = jnp.where(q_pos + qi * block_q >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
-        return dq_acc + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    dq = jax.lax.fori_loop(0, upper, body,
-                           jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
-
-
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q: int, seq_q: int,
-                          scale: float, causal: bool, block_k: int):
-    from jax.experimental import pallas as pl
-
-    ki = pl.program_id(1)
-    k = k_ref[0]  # [bk, d]
-    v = v_ref[0]  # [bk, d]
-    d = k.shape[-1]
 
     num_qb = seq_q // block_q
-    if causal:
-        # Only Q blocks at or after this K block's diagonal contribute.
-        lower = jnp.maximum(0, (ki * block_k) // block_q)
-    else:
-        lower = 0
+    num_kb = seq_k // block_k
+    d = q_ref.shape[-1]
+    q_pos0 = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos0 = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
 
-    k_pos = (jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-             + ki * block_k)
+    def head_body(hh, _):
+        dk_acc[...] = jnp.zeros((seq_k, d), jnp.float32)
+        dv_acc[...] = jnp.zeros((seq_k, d), jnp.float32)
 
-    def body(qb, carry):
-        dk_acc, dv_acc = carry
-        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :]
-        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :]
-        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q), :]
-        delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q), :]
-        s = jax.lax.dot_general(
-            q_blk, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = (jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0) + qb * block_q)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse_blk)  # [bq, bk] fp32
-        p_lo = p.astype(do_blk.dtype)
-        dv_new = dv_acc + jax.lax.dot_general(
-            p_lo, do_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
-            do_blk, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta_blk) * scale).astype(q_blk.dtype)
-        dk_new = dk_acc + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+        def q_body(qb, _q):
+            q = q_ref[0, hh, pl.ds(qb * block_q, block_q), :]
+            do = do_ref[0, hh, pl.ds(qb * block_q, block_q), :]
+            lse = lse_ref[0, hh, pl.ds(qb * block_q, block_q), :]
+            delta = delta_ref[0, hh, pl.ds(qb * block_q, block_q), :]
+            upper = (_causal_upper(qb, block_q, block_k, num_kb)
+                     if causal else num_kb)
 
-    dk, dv = jax.lax.fori_loop(
-        lower, num_qb, body,
-        (jnp.zeros((block_k, d), jnp.float32),
-         jnp.zeros((block_k, d), jnp.float32)))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+            def k_body(kb, dq_part):
+                k_blk = k_ref[0, hh, pl.ds(kb * block_k, block_k), :]
+                v_blk = v_ref[0, hh, pl.ds(kb * block_k, block_k), :]
+                s = jax.lax.dot_general(
+                    q, k_blk, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                if causal:
+                    s = jnp.where(
+                        q_pos0 + qb * block_q >= k_pos0 + kb * block_k,
+                        s, _NEG_INF)
+                p = jnp.exp(s - lse)  # [bq, bk] fp32
+                p_lo = p.astype(do.dtype)
+                dp = jax.lax.dot_general(
+                    do, v_blk, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                ds = (p * (dp - delta) * scale).astype(q.dtype)
+                dv_acc[pl.ds(kb * block_k, block_k), :] += (
+                    jax.lax.dot_general(
+                        p_lo, do, (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32))
+                dk_acc[pl.ds(kb * block_k, block_k), :] += (
+                    jax.lax.dot_general(
+                        ds, q, (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32))
+                return dq_part + jax.lax.dot_general(
+                    ds, k_blk, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+
+            dq = jax.lax.fori_loop(
+                0, upper, k_body, jnp.zeros((block_q, d), jnp.float32))
+            dq_ref[0, hh, pl.ds(qb * block_q, block_q), :] = (
+                dq.astype(dq_ref.dtype))
+            return 0
+
+        jax.lax.fori_loop(0, num_qb, q_body, 0)
+        dk_ref[0, hh] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, hh] = dv_acc[...].astype(dv_ref.dtype)
+        return 0
+
+    jax.lax.fori_loop(0, num_heads, head_body, 0)
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale,
@@ -278,47 +337,42 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale,
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bh = b * h
-    q3 = q.reshape(bh, sq, d)
-    k3 = k.reshape(bh, sk, d)
-    v3 = v.reshape(bh, sk, d)
-    do3 = do.reshape(bh, sq, d)
-    lse3 = lse.reshape(bh, sq, 1)
-    delta3 = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                     axis=-1).reshape(bh, sq, 1)
+    lse4 = lse.reshape(b, h, sq, 1)
+    delta4 = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                     axis=-1, keepdims=True)  # [b, h, sq, 1] fp32
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    esize = q.dtype.itemsize
+    # Full-seq q/k/v/do in, dq/dk/dv out, double-buffered, plus fp32
+    # compiler temps for the tile chain — empirically ~5.5MB/head at
+    # seq 1024/d 64, so budget ~40*sq*d bytes per folded head.
+    per_head = 5 * (7 * sq * d * esize + 8 * sq) + 8 * sk * d
+    hb = _pick_head_block(h, per_head)
 
-    qb_spec = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
-    qb1_spec = pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0))
-    kb_spec = pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0))
-    full_q = pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0))
-    full_q1 = pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0))
-    full_k = pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0))
+    full_q = pl.BlockSpec((1, hb, sq, d), lambda i, g: (i, g, 0, 0))
+    full_q1 = pl.BlockSpec((1, hb, sq, 1), lambda i, g: (i, g, 0, 0))
+    full_k = pl.BlockSpec((1, hb, sk, d), lambda i, g: (i, g, 0, 0))
 
-    dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, seq_k=sk,
-                          scale=scale, causal=causal, block_q=block_q),
-        grid=(bh, sq // block_q),
-        in_specs=[qb_spec, full_k, full_k, qb_spec, qb1_spec, qb1_spec],
-        out_specs=qb_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+    from jax.experimental.pallas import tpu as pltpu
+    scratch = [pltpu.VMEM((sk, d), jnp.float32),
+               pltpu.VMEM((sk, d), jnp.float32)]
+
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_fused_kernel, block_q=block_q,
+                          block_k=block_k, seq_q=sq, seq_k=sk, scale=scale,
+                          causal=causal, num_heads=hb),
+        grid=(b, h // hb),
+        in_specs=[full_q, full_k, full_k, full_q, full_q1, full_q1],
+        out_specs=[full_q, full_k, full_k],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk, d), v.dtype)],
+        scratch_shapes=scratch,
         interpret=interpret,
-    )(q3, k3, v3, do3, lse3, delta3)
+        compiler_params=_compiler_params(interpret),
+    )(q, k, v, do, lse4, delta4)
 
-    dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, seq_q=sq,
-                          scale=scale, causal=causal, block_k=block_k),
-        grid=(bh, sk // block_k),
-        in_specs=[full_q, kb_spec, kb_spec, full_q, full_q1, full_q1],
-        out_specs=[kb_spec, kb_spec],
-        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
-        interpret=interpret,
-    )(q3, k3, v3, do3, lse3, delta3)
-
-    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
-            dv.reshape(b, h, sk, d))
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------

@@ -56,8 +56,13 @@ class Checkpoint:
         return data
 
     def to_directory(self, path: Optional[str] = None) -> str:
-        if self._path is not None and path is None:
-            return self._path
+        if self._path is not None:
+            if path is None or os.path.abspath(path) == os.path.abspath(self._path):
+                return self._path
+            # Directory-backed checkpoint copied to an explicit target: the
+            # source directory's contents ARE the checkpoint.
+            shutil.copytree(self._path, path, dirs_exist_ok=True)
+            return path
         path = path or tempfile.mkdtemp(prefix="rt_ckpt_")
         os.makedirs(path, exist_ok=True)
         data = dict(self._data or {})
@@ -167,16 +172,23 @@ class CheckpointManager:
         best = sorted(scored, key=lambda e: e[1], reverse=rev)[0]
         return Checkpoint.from_directory(best[2])
 
+    def _badness(self, entry) -> tuple:
+        # Higher badness = deleted first. Unscored entries are worst; among
+        # scored ones the worst is the lowest score for 'max' order and the
+        # highest score for 'min' order.
+        step, score, _ = entry
+        if score is None:
+            return (1, 0)
+        return (0, -score if self.score_order == "max" else score)
+
     def _enforce_retention(self) -> None:
         if self.num_to_keep is None:
             return
+        # _entries stays in insertion (step) order so latest() keeps working.
         while len(self._entries) > self.num_to_keep:
             if self.score_attribute:
-                rev = self.score_order == "max"
-                self._entries.sort(
-                    key=lambda e: (e[1] is None, e[1] if rev else -(e[1] or 0)),
-                )
-                victim = self._entries.pop()  # worst score
+                victim = max(self._entries, key=self._badness)
+                self._entries.remove(victim)
             else:
                 victim = self._entries.pop(0)  # oldest
             shutil.rmtree(victim[2], ignore_errors=True)

@@ -1,0 +1,89 @@
+"""Memory-efficient optimizers for TPU HBM budgets.
+
+Reference analog: Ray Train delegates optimizer choice to user torch code;
+here the framework ships a TPU-first AdamW whose first/second moments are
+stored in bf16 (fp32 math per update) — halving optimizer-state HBM, which
+is what lets GPT-2 774M/1.5B-class models train on a single 16 GB chip
+(fp32 Adam state alone for 1.5B is ~12 GB). Same recipe as 8-bit Adam /
+low-precision state optimizers in common use; bf16's exponent range keeps
+the second moment well-conditioned.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def scale_by_adam_lowmem(
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    state_dtype: Any = jnp.bfloat16,
+) -> optax.GradientTransformation:
+    """Adam moment tracking with moments stored in ``state_dtype``.
+
+    Update math runs in fp32 (moments are upcast, new moments downcast on
+    store). Unlike ``optax.scale_by_adam(mu_dtype=...)`` this applies to the
+    second moment too, which is the same size as the first.
+    """
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=state_dtype)
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree.map(zeros, params),
+            nu=jax.tree.map(zeros, params),
+        )
+
+    def update(updates, state, params=None):
+        del params
+        count = state.count + 1
+
+        def next_mu(m, g):
+            g = g.astype(jnp.float32)
+            return b1 * m.astype(jnp.float32) + (1.0 - b1) * g
+
+        def next_nu(v, g):
+            g = g.astype(jnp.float32)
+            return b2 * v.astype(jnp.float32) + (1.0 - b2) * jnp.square(g)
+
+        mu = jax.tree.map(next_mu, state.mu, updates)
+        nu = jax.tree.map(next_nu, state.nu, updates)
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        def direction(m, v):
+            return (m / c1) / (jnp.sqrt(v / c2) + eps)
+
+        new_updates = jax.tree.map(direction, mu, nu)
+        cast = lambda t: jax.tree.map(
+            lambda x: x.astype(state_dtype), t)
+        return new_updates, optax.ScaleByAdamState(
+            count=count, mu=cast(mu), nu=cast(nu))
+
+    return optax.GradientTransformation(init, update)
+
+
+def adamw_lowmem(
+    learning_rate,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    eps: float = 1e-8,
+    weight_decay: float = 0.1,
+    grad_clip: Optional[float] = 1.0,
+    state_dtype: Any = jnp.bfloat16,
+) -> optax.GradientTransformation:
+    """AdamW with low-precision moment state (drop-in for the default)."""
+    parts = []
+    if grad_clip is not None:
+        parts.append(optax.clip_by_global_norm(grad_clip))
+    parts += [
+        scale_by_adam_lowmem(b1=b1, b2=b2, eps=eps, state_dtype=state_dtype),
+        optax.add_decayed_weights(weight_decay),
+        optax.scale_by_learning_rate(learning_rate),
+    ]
+    return optax.chain(*parts)

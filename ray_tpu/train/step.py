@@ -56,6 +56,7 @@ def build_sharded_train(
     optimizer: Optional[optax.GradientTransformation] = None,
     batch_logical_axes: Tuple = ("batch", "seq"),
     donate: bool = True,
+    master_fp32: bool = False,
 ):
     """Compile (init, step) over a mesh.
 
@@ -64,6 +65,10 @@ def build_sharded_train(
       loss_fn: ``(params, batch) -> scalar loss`` (already mesh-rule aware
         via ``constrain`` annotations inside the model).
       mesh: the device mesh; rules are pruned to its non-trivial axes.
+      master_fp32: standard TPU mixed precision — live params (and hence
+        grads) are bf16 while an fp32 master copy lives in the optimizer
+        state; each step updates the master and re-casts. Halves the
+        gradient HBM footprint vs fp32 params.
 
     Returns (sharded_init, sharded_step, placed_rules) where
       sharded_init: ``key -> (params, opt_state)`` placed on the mesh
@@ -105,14 +110,28 @@ def build_sharded_train(
 
         return jax.tree.map(pick, opt_shape)
 
-    opt_shardings = opt_shardings_like(param_shardings)
+    inner_opt_shardings = opt_shardings_like(param_shardings)
+    if master_fp32:
+        opt_shardings = {"master": param_shardings,
+                         "inner": inner_opt_shardings}
+    else:
+        opt_shardings = inner_opt_shardings
     step_sharding = NamedSharding(mesh, P())
 
     @partial(jax.jit,
              out_shardings=(param_shardings, opt_shardings, step_sharding))
     def sharded_init(key):
         params = _init(key)
-        opt_state = optimizer.init(params)
+        if master_fp32:
+            master = params
+            opt_state = {"master": master,
+                         "inner": optimizer.init(master)}
+            params = jax.tree.map(
+                lambda p: p.astype(jnp.bfloat16)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                master)
+        else:
+            opt_state = optimizer.init(params)
         return params, opt_state, jnp.zeros((), jnp.int32)
 
     batch_sharding = NamedSharding(mesh, batch_spec)
@@ -131,8 +150,19 @@ def build_sharded_train(
             batch,
         )
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        if master_fp32:
+            master, inner = opt_state["master"], opt_state["inner"]
+            grads32 = jax.tree.map(
+                lambda g: g.astype(jnp.float32)
+                if jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
+            updates, inner = optimizer.update(grads32, inner, master)
+            master = optax.apply_updates(master, updates)
+            params = jax.tree.map(
+                lambda m, p: m.astype(p.dtype), master, params)
+            opt_state = {"master": master, "inner": inner}
+        else:
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
         gnorm = optax.global_norm(grads)
         return params, opt_state, step + 1, {"loss": loss, "grad_norm": gnorm}
 
@@ -145,15 +175,20 @@ def build_sharded_train(
 def _under_mesh(mesh: Mesh, fn):
     from ..parallel.sharding import set_current_mesh
 
-    def wrapped(*args, **kwargs):
+    def _call(target, *args, **kwargs):
         prev = None
         set_current_mesh(mesh)
         try:
             with jax.set_mesh(mesh):
-                return fn(*args, **kwargs)
+                return target(*args, **kwargs)
         finally:
             set_current_mesh(prev)
 
+    def wrapped(*args, **kwargs):
+        return _call(fn, *args, **kwargs)
+
+    # AOT path (compile checks with abstract inputs, no execution).
+    wrapped.lower = lambda *a, **kw: _call(fn.lower, *a, **kw)
     return wrapped
 
 

@@ -104,3 +104,51 @@ def test_zero_copy_create_seal():
         assert store.stats()["used_bytes"] >= arr.nbytes
     finally:
         store.close()
+
+
+def test_deferred_delete_under_pinned_reader():
+    """delete-while-pinned defers the extent free until the last release;
+    a put of the same key while pending raises instead of silently
+    dropping data (plasma-style safety for zero-copy readers)."""
+    from ray_tpu._native import NativeStorePendingDelete
+
+    store = NativeStore.create("/rt_test_pd", 1024 * 1024)
+    try:
+        store.put(_key(40), b"payload-a")
+        view = store.get(_key(40))  # pin
+        used_before = store.stats()["used_bytes"]
+        assert store.delete(_key(40))  # deferred, key gone immediately
+        assert not store.contains(_key(40))
+        # the pinned zero-copy view stays valid and bytes stay allocated
+        assert bytes(view[:9]) == b"payload-a"
+        assert store.stats()["used_bytes"] == used_before
+        try:
+            store.put(_key(40), b"payload-b")
+            raise AssertionError("put over pending-delete must raise")
+        except NativeStorePendingDelete:
+            pass
+        store.release(_key(40))  # last reader -> extent freed
+        assert store.stats()["used_bytes"] < used_before
+        store.put(_key(40), b"payload-b")
+        view2 = store.get(_key(40))
+        assert bytes(view2[:9]) == b"payload-b"
+        store.release(_key(40))
+    finally:
+        store.close()
+
+
+def test_sliver_absorb_accounting():
+    """Alloc/free churn with absorbed slivers must return used_bytes to
+    baseline (regression: absorbed sliver bytes were leaked)."""
+    store = NativeStore.create("/rt_test_sl", 1024 * 1024)
+    try:
+        baseline = store.stats()["used_bytes"]
+        for round_ in range(50):
+            keys = [(1000 + round_ * 10 + i) for i in range(8)]
+            for i, k in enumerate(keys):
+                store.put(_key(k), bytes(37 + 61 * i))
+            for k in keys:
+                assert store.delete(_key(k))
+        assert store.stats()["used_bytes"] == baseline
+    finally:
+        store.close()
