@@ -281,6 +281,7 @@ class SharedMemoryStore:
         for meta in sorted(
                 (m for m in self._meta.values()
                  if m.pinned == 0 and m.spilled_path is None
+                 and m.backend == "arena"
                  and m.object_id != object_id),
                 key=lambda m: m.last_access):
             self._spill(meta)

@@ -7,9 +7,11 @@ RolloutWorker/WorkerSet, SampleBatch, env abstractions).
 
 from .algorithm import Algorithm, AlgorithmConfig, WorkerSet
 from .dqn import DQN, DQNConfig
-from .env import FastCartPole, GymVectorEnv, VectorEnv, make_env
+from .env import AtariSim, FastCartPole, GymVectorEnv, VectorEnv, make_env
 from .impala import Impala, ImpalaConfig, vtrace
-from .policy import JaxPolicy
+from .ondevice import JAX_ENVS, JaxEnv, OnDevicePPO, jax_atari_sim, \
+    jax_cartpole
+from .policy import JaxPolicy, make_network
 from .ppo import PPO, PPOConfig
 from .replay_buffers import (
     MultiAgentReplayBuffer,
@@ -21,10 +23,11 @@ from .rollout_worker import RolloutWorker
 from .sample_batch import SampleBatch, compute_gae
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "DQN", "DQNConfig", "FastCartPole",
-    "GymVectorEnv", "Impala", "ImpalaConfig", "JaxPolicy",
-    "MultiAgentReplayBuffer", "PPO",
+    "Algorithm", "AlgorithmConfig", "AtariSim", "DQN", "DQNConfig",
+    "FastCartPole", "GymVectorEnv", "Impala", "ImpalaConfig", "JAX_ENVS",
+    "JaxEnv", "JaxPolicy", "MultiAgentReplayBuffer", "OnDevicePPO", "PPO",
     "PPOConfig", "PrioritizedReplayBuffer", "ReplayBuffer",
     "ReservoirReplayBuffer", "RolloutWorker", "SampleBatch", "VectorEnv",
-    "WorkerSet", "compute_gae", "make_env",
+    "WorkerSet", "compute_gae", "jax_atari_sim", "jax_cartpole",
+    "make_env", "make_network",
 ]

@@ -34,6 +34,8 @@ class AlgorithmConfig:
         self.train_batch_size: int = 2048
         self.seed: int = 0
         self.policy_hidden: tuple = (64, 64)
+        # "auto" = conv (Nature CNN) for [H,W,C] frame obs, mlp otherwise
+        self.policy_network: str = "auto"
         self.extra: Dict[str, Any] = {}
 
     def environment(self, env: Any = None, **kwargs) -> "AlgorithmConfig":
@@ -95,7 +97,8 @@ class WorkerSet:
         worker_cls = worker_cls or RolloutWorker
         self.local_worker = worker_cls(
             config.env, config.num_envs_per_worker,
-            {"hidden": config.policy_hidden}, seed=config.seed,
+            {"hidden": config.policy_hidden,
+             "network": config.policy_network}, seed=config.seed,
         )
         self.remote_workers: List[Any] = []
         if config.num_rollout_workers > 0:
@@ -103,7 +106,8 @@ class WorkerSet:
             self.remote_workers = [
                 remote_cls.options(num_cpus=1).remote(
                     config.env, config.num_envs_per_worker,
-                    {"hidden": config.policy_hidden},
+                    {"hidden": config.policy_hidden,
+                     "network": config.policy_network},
                     seed=config.seed, worker_index=i + 1,
                 )
                 for i in range(config.num_rollout_workers)

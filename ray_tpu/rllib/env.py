@@ -132,6 +132,83 @@ class FastCartPole(VectorEnv):
         return self._state.copy(), rewards, done, {}
 
 
+class AtariSim(VectorEnv):
+    """Synthetic Atari-SHAPED env: 84x84x4 uint8 frame-stack observations,
+    6 actions, pong-like ball/paddle dynamics rendered with vectorized
+    numpy — the workload shape of the reference's Atari throughput configs
+    (frame tensors, conv policy) without ALE ROMs, which this image lacks.
+    Rewards: +1 when the paddle tracks the ball row at frame events.
+    """
+
+    H = W = 84
+    STACK = 4
+    MAX_STEPS = 1000
+
+    def __init__(self, num_envs: int = 1, seed: int = 0):
+        self.num_envs = num_envs
+        self.observation_space_shape = (self.H, self.W, self.STACK)
+        self.num_actions = 6
+        self._rng = np.random.default_rng(seed)
+        n = num_envs
+        self._ball = np.zeros((n, 2), np.float32)    # (y, x)
+        self._vel = np.zeros((n, 2), np.float32)
+        self._paddle = np.zeros(n, np.float32)       # y position
+        self._steps = np.zeros(n, np.int32)
+        self._frames = np.zeros((n, self.H, self.W, self.STACK), np.uint8)
+
+    def _reset_some(self, mask: np.ndarray) -> None:
+        n = int(mask.sum())
+        if not n:
+            return
+        self._ball[mask] = self._rng.uniform(20, 60, (n, 2))
+        self._vel[mask] = self._rng.choice([-2.0, -1.0, 1.0, 2.0], (n, 2))
+        self._paddle[mask] = self.H / 2
+        self._steps[mask] = 0
+        self._frames[mask] = 0
+
+    def vector_reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._reset_some(np.ones(self.num_envs, bool))
+        self._render()
+        return self._frames.copy()
+
+    def _render(self) -> None:
+        # Shift the stack and draw ball + paddle into the newest frame.
+        self._frames[..., :-1] = self._frames[..., 1:]
+        new = np.zeros((self.num_envs, self.H, self.W), np.uint8)
+        idx = np.arange(self.num_envs)
+        by = np.clip(self._ball[:, 0].astype(int), 1, self.H - 2)
+        bx = np.clip(self._ball[:, 1].astype(int), 1, self.W - 2)
+        for dy in (-1, 0, 1):
+            for dx in (-1, 0, 1):
+                new[idx, by + dy, bx + dx] = 255
+        py = np.clip(self._paddle.astype(int), 4, self.H - 5)
+        for dy in range(-4, 5):
+            new[idx, py + dy, self.W - 3] = 200
+        self._frames[..., -1] = new
+
+    def vector_step(self, actions):
+        # 0/1: stay, 2/4: up, 3/5: down (Atari Pong action semantics-ish)
+        move = np.where(np.isin(actions, (2, 4)), -2.0,
+                        np.where(np.isin(actions, (3, 5)), 2.0, 0.0))
+        self._paddle = np.clip(self._paddle + move, 4, self.H - 5)
+        self._ball += self._vel
+        for axis, lim in ((0, self.H - 2), (1, self.W - 2)):
+            low = self._ball[:, axis] < 1
+            high = self._ball[:, axis] > lim
+            self._vel[low | high, axis] *= -1
+            self._ball[:, axis] = np.clip(self._ball[:, axis], 1, lim)
+        hit = (self._ball[:, 1] > self.W - 6) & (
+            np.abs(self._ball[:, 0] - self._paddle) < 5)
+        rewards = hit.astype(np.float32)
+        self._steps += 1
+        done = self._steps >= self.MAX_STEPS
+        self._reset_some(done)
+        self._render()
+        return self._frames.copy(), rewards, done, {}
+
+
 def make_env(env: Any, num_envs: int, seed: int = 0) -> VectorEnv:
     """Resolve an env spec: VectorEnv instance, factory, or gym id."""
     if isinstance(env, VectorEnv):
@@ -143,4 +220,6 @@ def make_env(env: Any, num_envs: int, seed: int = 0) -> VectorEnv:
         raise TypeError("env factory must return a VectorEnv")
     if env == "FastCartPole":
         return FastCartPole(num_envs, seed)
+    if env == "AtariSim":
+        return AtariSim(num_envs, seed)
     return GymVectorEnv(env, num_envs)

@@ -62,19 +62,20 @@ def vtrace(behavior_logp, target_logp, rewards, dones, values, bootstrap,
     return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
 
 
-def impala_loss(params, batch, gamma, vf_coeff, ent_coeff):
+def impala_loss(params, batch, gamma, vf_coeff, ent_coeff,
+                apply_fn=forward_mlp):
     """batch: time-major [T, B] columns + final_obs [B, obs]."""
     obs = batch[OBS]
     t_len, n = obs.shape[:2]
     flat_obs = obs.reshape((t_len * n,) + obs.shape[2:])
-    logits, values = forward_mlp(params, flat_obs)
+    logits, values = apply_fn(params, flat_obs)
     logits = logits.reshape(t_len, n, -1)
     values = values.reshape(t_len, n)
     logp_all = jax.nn.log_softmax(logits)
     actions = batch[ACTIONS].astype(jnp.int32)
     target_logp = jnp.take_along_axis(
         logp_all, actions[..., None], axis=-1)[..., 0]
-    _, bootstrap = forward_mlp(params, batch["final_obs"])
+    _, bootstrap = apply_fn(params, batch["final_obs"])
 
     vs, pg_adv = vtrace(batch[LOGPS], target_logp, batch[REWARDS],
                         batch[DONES], values, bootstrap, gamma)
@@ -131,12 +132,13 @@ class Impala(Algorithm):
 
         gamma = config.gamma
         vf_coeff, ent_coeff = config.vf_coeff, config.entropy_coeff
+        apply_fn = self.workers.local_worker.policy.net.apply
 
         @jax.jit
         def update(params, opt_state, batch):
             (loss, metrics), grads = jax.value_and_grad(
                 impala_loss, has_aux=True)(params, batch, gamma,
-                                           vf_coeff, ent_coeff)
+                                           vf_coeff, ent_coeff, apply_fn)
             updates, opt_state = self.optimizer.update(grads, opt_state,
                                                        params)
             params = optax.apply_updates(params, updates)

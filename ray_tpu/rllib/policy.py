@@ -60,9 +60,108 @@ def forward_mlp(params: Dict, obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarra
     return logits, values
 
 
-@functools.partial(jax.jit, static_argnums=(3,))
-def _sample_actions(params, obs, key, deterministic: bool):
-    logits, values = forward_mlp(params, obs)
+# Nature-DQN conv trunk as (out_channels, kernel, stride) — single source
+# for both init (shape math) and apply (stride schedule).
+_CONV_SPEC = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+
+
+def init_conv_policy(key, obs_shape: Tuple[int, ...], num_actions: int,
+                     dense: int = 512) -> Dict:
+    """Nature-CNN actor-critic for Atari-shaped [H, W, C] frames.
+
+    Reference analog: the conv stacks ``rllib/models/catalog.py`` builds
+    for image observations (Nature DQN filters 32x8x8/4, 64x4x4/2,
+    64x3x3/1 -> dense 512), with separate policy/value heads off a shared
+    conv trunk (the standard Atari PPO topology).
+    """
+    h, w, c = obs_shape
+    keys = jax.random.split(key, 6)
+    params: Dict = {}
+    cin = c
+    for i, (cout, k, stride) in enumerate(_CONV_SPEC):
+        std = float(np.sqrt(2.0 / (k * k * cin)))
+        params[f"conv{i}_w"] = truncated_normal(
+            keys[i], (k, k, cin, cout), stddev=std)
+        params[f"conv{i}_b"] = jnp.zeros((cout,))
+        h = (h - k) // stride + 1
+        w = (w - k) // stride + 1
+        cin = cout
+    flat = h * w * cin
+    params["dense_w"] = truncated_normal(
+        keys[3], (flat, dense), stddev=float(np.sqrt(2.0 / flat)))
+    params["dense_b"] = jnp.zeros((dense,))
+    params["pi_w"] = truncated_normal(keys[4], (dense, num_actions),
+                                      stddev=0.01)
+    params["pi_b"] = jnp.zeros((num_actions,))
+    params["vf_w"] = truncated_normal(keys[5], (dense, 1), stddev=1.0)
+    params["vf_b"] = jnp.zeros((1,))
+    return params
+
+
+def forward_conv(params: Dict, obs: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, H, W, C] (uint8 or float) -> (logits [B, A], values [B]).
+
+    The conv/dense trunk runs in bf16 (MXU native; fp32 convs are ~4-8x
+    slower on TPU) with fp32 policy/value heads — logits precision is
+    what matters for the categorical sample and the PPO ratio.
+    """
+    x = obs.astype(jnp.float32)
+    if obs.dtype == jnp.uint8:
+        x = x / 255.0
+    x = x.astype(jnp.bfloat16)
+    for i, (_cout, _k, stride) in enumerate(_CONV_SPEC):
+        x = jax.lax.conv_general_dilated(
+            x, params[f"conv{i}_w"].astype(x.dtype),
+            window_strides=(stride, stride), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + params[f"conv{i}_b"].astype(x.dtype)
+        x = jax.nn.relu(x)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["dense_w"].astype(x.dtype)
+                    + params["dense_b"].astype(x.dtype))
+    x = x.astype(jnp.float32)
+    logits = x @ params["pi_w"] + params["pi_b"]
+    values = (x @ params["vf_w"] + params["vf_b"])[..., 0]
+    return logits, values
+
+
+@dataclass(frozen=True)
+class Network:
+    """A policy network: pure (init, apply) over a param pytree."""
+    kind: str
+    init: Callable[[Any], Dict]
+    apply: Callable[[Dict, jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]]
+
+
+def make_network(obs_shape: Tuple[int, ...], num_actions: int,
+                 kind: str = "auto",
+                 hidden: Sequence[int] = (64, 64)) -> Network:
+    """'mlp' for vector obs, 'conv' (Nature CNN) for [H,W,C] frames;
+    'auto' picks by observation rank."""
+    if kind == "auto":
+        kind = "conv" if len(obs_shape) == 3 else "mlp"
+    if kind == "conv":
+        return Network(
+            kind="conv",
+            init=lambda key: init_conv_policy(key, obs_shape, num_actions),
+            apply=forward_conv,
+        )
+    obs_dim = int(np.prod(obs_shape))
+
+    def apply_flat(params, obs):
+        return forward_mlp(params, obs.reshape(obs.shape[0], -1))
+
+    return Network(
+        kind="mlp",
+        init=lambda key: init_mlp_policy(key, obs_dim, num_actions, hidden),
+        apply=apply_flat,
+    )
+
+
+def sample_actions(apply_fn, params, obs, key, deterministic: bool):
+    """Pure sampling head shared by host policies and on-device rollout."""
+    logits, values = apply_fn(params, obs)
     if deterministic:
         actions = jnp.argmax(logits, axis=-1)
     else:
@@ -77,18 +176,23 @@ class JaxPolicy:
     """Discrete-action actor-critic policy."""
 
     def __init__(self, obs_shape: Tuple[int, ...], num_actions: int,
-                 hidden: Sequence[int] = (64, 64), seed: int = 0):
+                 hidden: Sequence[int] = (64, 64), seed: int = 0,
+                 network: str = "auto"):
         self.obs_dim = int(np.prod(obs_shape))
         self.num_actions = num_actions
+        self.net = make_network(obs_shape, num_actions, network, hidden)
         key = jax.random.PRNGKey(seed)
-        self.params = init_mlp_policy(key, self.obs_dim, num_actions, hidden)
+        self.params = self.net.init(key)
         self._key = jax.random.PRNGKey(seed + 1)
+        self._sample = jax.jit(
+            functools.partial(sample_actions, self.net.apply),
+            static_argnums=(3,))
 
     def compute_actions(self, obs: np.ndarray, deterministic: bool = False):
         """Reference: Policy.compute_actions (:411)."""
-        obs = np.asarray(obs, np.float32).reshape(len(obs), -1)
+        obs = np.asarray(obs)
         self._key, sub = jax.random.split(self._key)
-        actions, logp, values = _sample_actions(
+        actions, logp, values = self._sample(
             self.params, jnp.asarray(obs), sub, deterministic
         )
         return (np.asarray(actions), np.asarray(logp), np.asarray(values))

@@ -19,7 +19,7 @@ import numpy as np
 import optax
 
 from .algorithm import Algorithm, AlgorithmConfig
-from .policy import forward_mlp, init_mlp_policy
+from .policy import forward_mlp
 from .sample_batch import (
     ACTIONS,
     ADVANTAGES,
@@ -61,8 +61,9 @@ class PPOConfig(AlgorithmConfig):
         return self
 
 
-def ppo_loss(params, batch, clip_param, vf_clip, vf_coeff, ent_coeff):
-    logits, values = forward_mlp(params, batch[OBS])
+def ppo_loss(params, batch, clip_param, vf_clip, vf_coeff, ent_coeff,
+             apply_fn=forward_mlp):
+    logits, values = apply_fn(params, batch[OBS])
     logp_all = jax.nn.log_softmax(logits)
     actions = batch[ACTIONS].astype(jnp.int32)
     logp = jnp.take_along_axis(logp_all, actions[:, None], axis=-1)[:, 0]
@@ -86,7 +87,7 @@ def ppo_loss(params, batch, clip_param, vf_clip, vf_coeff, ent_coeff):
     }
 
 
-def build_ppo_update(config: PPOConfig, optimizer):
+def build_ppo_update(config: PPOConfig, optimizer, apply_fn=forward_mlp):
     """One compiled program: epochs x minibatches of SGD.
 
     The minibatch schedule is a static reshape + permutation consumed by
@@ -116,7 +117,7 @@ def build_ppo_update(config: PPOConfig, optimizer):
                 params, opt_state = carry
                 (loss, aux), grads = jax.value_and_grad(
                     ppo_loss, has_aux=True
-                )(params, mb, clip, vfc, vco, eco)
+                )(params, mb, clip, vfc, vco, eco, apply_fn)
                 updates, opt_state = optimizer.update(grads, opt_state,
                                                       params)
                 params = optax.apply_updates(params, updates)
@@ -151,7 +152,9 @@ class PPO(Algorithm):
             jnp.asarray, self.workers.local_worker.policy.params
         )
         self.opt_state = self.optimizer.init(self.params)
-        self._update = build_ppo_update(config, self.optimizer)
+        self._update = build_ppo_update(
+            config, self.optimizer,
+            self.workers.local_worker.policy.net.apply)
         self._rng = jax.random.PRNGKey(config.seed)
         self.workers.sync_weights(jax.tree.map(np.asarray, self.params))
 

@@ -53,6 +53,7 @@ class RolloutWorker:
         return JaxPolicy(
             self.env.observation_space_shape, self.env.num_actions,
             hidden=cfg.get("hidden", (64, 64)), seed=seed,
+            network=cfg.get("network", "auto"),
         )
 
     def apply(self, fn) -> Any:
@@ -68,9 +69,13 @@ class RolloutWorker:
     def sample(self, rollout_length: int = 128) -> SampleBatch:
         """Collect a [T, N, ...] fragment; auto-resetting envs."""
         n = self.env.num_envs
+        # Preserve the env's obs dtype: forward_conv keys its /255
+        # normalization on uint8, so coercing frames to float32 here would
+        # make the training batch see a DIFFERENT function than the one
+        # that sampled the actions (breaking the PPO importance ratio).
         obs_buf = np.empty((rollout_length, n) +
                            tuple(self.env.observation_space_shape),
-                           np.float32)
+                           np.asarray(self._obs).dtype)
         act_buf = np.empty((rollout_length, n), np.int32)
         logp_buf = np.empty((rollout_length, n), np.float32)
         vf_buf = np.empty((rollout_length, n), np.float32)
@@ -100,7 +105,7 @@ class RolloutWorker:
         # Final observation [N, obs]: V-trace bootstraps V(x_T) under the
         # *learner's* policy (IMPALA), so ship the state, not just the
         # behavior-policy value estimate.
-        batch["final_obs"] = np.asarray(self._obs, np.float32)
+        batch["final_obs"] = np.asarray(self._obs)
         return batch
 
     def episode_stats(self, clear: bool = True) -> Dict:

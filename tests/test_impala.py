@@ -119,3 +119,21 @@ def test_impala_learns_cartpole(rt_shared):
             break
     algo.stop()
     assert best >= 100, f"IMPALA failed to learn CartPole (best={best})"
+
+
+def test_impala_conv_policy_smoke():
+    """IMPALA learner uses the policy's own network apply (conv for
+    image-shaped envs), not a hardcoded MLP."""
+    from ray_tpu.rllib import ImpalaConfig
+
+    config = (ImpalaConfig()
+              .environment("AtariSim")
+              .rollouts(num_rollout_workers=0, num_envs_per_worker=2,
+                        rollout_fragment_length=4)
+              .training(num_batches_per_iter=1))
+    algo = config.build()
+    try:
+        result = algo.train()
+        assert result["timesteps_this_iter"] >= 8
+    finally:
+        algo.stop()
