@@ -134,7 +134,31 @@ def main():
         result.update(bench_ppo(on_tpu))
     except Exception as e:  # PPO bench must never break the MFU line
         result["ppo_error"] = repr(e)[:200]
+    try:
+        result["core_microbench"] = bench_core()
+    except Exception as e:
+        result["core_microbench_error"] = repr(e)[:200]
     print(json.dumps(result))
+
+
+def bench_core() -> dict:
+    """Core runtime microbenchmarks (reference: ray_perf.py scenarios).
+    Host-bound numbers — this box has 1 CPU core; see scenario names."""
+    import ray_tpu as rt
+    from ray_tpu.scripts.microbenchmark import main as micro_main
+
+    try:
+        rows = micro_main(duration=1.0)
+    finally:
+        try:
+            rt.shutdown()
+        except Exception:
+            pass
+    out = {}
+    for row in rows:
+        key = row["name"].replace(" ", "_").replace(":", "_")
+        out[key] = row.get("GB_per_s", row["ops_per_s"])
+    return out
 
 
 def bench_ppo(on_tpu: bool) -> dict:

@@ -36,6 +36,17 @@ class PlacementGroup:
     # node chosen per bundle index once scheduled
     bundle_nodes: List[Optional[NodeID]] = field(default_factory=list)
     state: str = "PENDING"  # PENDING | CREATED | REMOVED | UNSCHEDULABLE
+    # set when the PG reaches a state wait() can act on; re-armed when a
+    # retry moves it back to PENDING
+    _event: threading.Event = field(default_factory=threading.Event,
+                                    repr=False, compare=False)
+
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        if state == "PENDING":
+            self._event.clear()
+        else:
+            self._event.set()
 
     def ready(self) -> "ObjectRefLike":
         """Returns a waitable that resolves when the PG is scheduled."""
@@ -46,13 +57,16 @@ class PlacementGroup:
 
     def wait(self, timeout_seconds: float = 30.0) -> bool:
         deadline = time.monotonic() + timeout_seconds
-        while time.monotonic() < deadline:
+        while True:
             if self.state == "CREATED":
                 return True
-            if self.state == "UNSCHEDULABLE":
+            if self.state in ("UNSCHEDULABLE", "REMOVED"):
                 return False
-            time.sleep(0.005)
-        return self.state == "CREATED"
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return self.state == "CREATED"
+            # capped so a clear()-then-set() race can't oversleep
+            self._event.wait(min(remaining, 0.5))
 
 
 class _PGReady:
@@ -140,10 +154,12 @@ class PlacementGroupManager:
                 break
         if not ok:
             rollback()
-            pg.state = "UNSCHEDULABLE" if not self._feasible_later(pg) else "PENDING"
+            pg._set_state(
+                "UNSCHEDULABLE" if not self._feasible_later(pg)
+                else "PENDING")
             return
         pg.bundle_nodes = [n.node_id for n in assignment]
-        pg.state = "CREATED"
+        pg._set_state("CREATED")
 
     def _feasible_later(self, pg: PlacementGroup) -> bool:
         nodes = [n for n in self._rt.scheduler.nodes() if n.alive]
@@ -169,7 +185,7 @@ class PlacementGroupManager:
             node = self._rt.scheduler.get_node(node_id)
             if node is not None:
                 node.return_bundle(pg.id, idx)
-        pg.state = "REMOVED"
+        pg._set_state("REMOVED")
         self._rt.scheduler.notify()
 
     def get(self, pg_id: PlacementGroupID) -> Optional[PlacementGroup]:

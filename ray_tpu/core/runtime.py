@@ -61,6 +61,9 @@ class _ObjectEntry:
     futures: List[Future] = field(default_factory=list)
     waiting_tasks: List[TaskID] = field(default_factory=list)
     creating_task: Optional[TaskID] = None
+    # one-shot callbacks fired (outside the lock) on READY/FAILED — the
+    # async wait/watch path; unlike futures these don't materialize values
+    watchers: List = field(default_factory=list)
 
 
 @dataclass
@@ -110,6 +113,9 @@ class Runtime:
         self.serializer = Serializer(ref_class=ObjectRef)
         self.memory_store = MemoryStore()
         self._lock = threading.RLock()
+        # Signalled on every object READY/FAILED transition; wait() blocks
+        # on this instead of polling (reference: WaitManager wakeups).
+        self._obj_cond = threading.Condition(self._lock)
         self._objects: Dict[ObjectID, _ObjectEntry] = {}
         self._tasks: Dict[TaskID, _TaskRecord] = {}
         self._lineage: Dict[TaskID, TaskSpec] = {}
@@ -313,6 +319,9 @@ class Runtime:
             entry.futures = []
             waiting = entry.waiting_tasks
             entry.waiting_tasks = []
+            watchers = entry.watchers
+            entry.watchers = []
+            self._obj_cond.notify_all()
         for fut in futures:
             try:
                 fut.set_result(self._materialize_value(oid))
@@ -320,6 +329,8 @@ class Runtime:
                 fut.set_exception(e)
         for task_id in waiting:
             self._dep_ready(task_id)
+        for cb in watchers:
+            cb()
 
     def _mark_failed(self, oid: ObjectID, error: Exception) -> None:
         with self._lock:
@@ -330,10 +341,15 @@ class Runtime:
             entry.futures = []
             waiting = entry.waiting_tasks
             entry.waiting_tasks = []
+            watchers = entry.watchers
+            entry.watchers = []
+            self._obj_cond.notify_all()
         for fut in futures:
             fut.set_exception(error)
         for task_id in waiting:
             self._dep_ready(task_id)
+        for cb in watchers:
+            cb()
 
     # ------------------------------------------------------------------- get
     def get(self, refs, timeout: Optional[float] = None):
@@ -412,21 +428,26 @@ class Runtime:
         if num_returns > len(refs):
             raise ValueError("num_returns exceeds number of refs")
         deadline = None if timeout is None else time.monotonic() + timeout
-        cond = threading.Condition()
         done: set = set()
 
         def check() -> bool:
-            with self._lock:
-                for r in refs:
-                    e = self._objects.get(r.id)
-                    if e is not None and e.status in (_ObjStatus.READY, _ObjStatus.FAILED):
-                        done.add(r.id)
+            for r in refs:
+                e = self._objects.get(r.id)
+                if e is not None and e.status in (_ObjStatus.READY,
+                                                  _ObjStatus.FAILED):
+                    done.add(r.id)
             return len(done) >= num_returns
 
-        while not check():
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            time.sleep(0.002)
+        # Condvar wakeup on READY/FAILED transitions; the 1s cap is a
+        # belt-and-braces re-check, not the latency path.
+        with self._obj_cond:
+            while not check():
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    break
+                self._obj_cond.wait(
+                    1.0 if remaining is None else min(remaining, 1.0))
         ready = [r for r in refs if r.id in done][:num_returns]
         ready_ids = {r.id for r in ready}
         not_ready = [r for r in refs if r.id not in ready_ids]
@@ -905,11 +926,27 @@ class Runtime:
                 self._fail_task(record, error,
                                 retryable=record.spec.retry_exceptions)
             self.scheduler.notify()
-        elif kind in ("get", "wait", "put", "submit", "kill_actor", "cancel",
-                      "get_actor"):
-            threading.Thread(
-                target=self._handle_worker_rpc, args=(worker, msg), daemon=True
-            ).start()
+        elif kind in ("get", "wait"):
+            # Guard: a handler exception must become an error REPLY, not
+            # kill this worker's reader loop (which would hang the worker).
+            try:
+                if kind == "get":
+                    self._handle_get_async(worker, msg)
+                else:
+                    self._handle_wait_async(worker, msg)
+            except Exception as e:  # noqa: BLE001
+                try:
+                    worker.send(("reply", msg[1], False, e))
+                except Exception:
+                    pass
+        elif kind in ("put", "submit", "kill_actor", "cancel", "get_actor"):
+            # Quick, non-blocking RPCs run inline on this worker's reader
+            # thread (ordering preserved, no thread churn). Blocking
+            # get/wait are fully ASYNC above — callbacks on object
+            # completion, never a parked thread — so deep nested-task
+            # fan-outs can't exhaust any handler pool (reference: the
+            # event-loop design of the C++ core worker RPC handlers).
+            self._handle_worker_rpc(worker, msg)
 
     def _mark_ready_creation_returns(self, record: _TaskRecord, results) -> None:
         for i, (kind, payload) in enumerate(results):
@@ -933,42 +970,146 @@ class Runtime:
                 self._mark_ready(oid, ("shm", record.node.node_id, size))
         self._decrement_arg_pins(spec)
 
+    def _handle_get_async(self, worker: WorkerHandle, msg: tuple) -> None:
+        """Worker get RPC without a parked thread: reply is assembled by a
+        completion callback on the last future (timeout via Timer)."""
+        _, req_id, id_bins, timeout = msg
+        refs = [ObjectRef(ObjectID(b), _register=False) for b in id_bins]
+        self._mark_worker_blocked(worker)
+        try:
+            futures = [self.object_future(r) for r in refs]
+        except Exception:
+            self._mark_worker_unblocked(worker)
+            raise
+        n = len(futures)
+        state = {"done": 0, "sent": False}
+        slock = threading.Lock()
+        timer: List[Optional[threading.Timer]] = [None]
+
+        def finalize(timed_out: bool) -> None:
+            with slock:
+                if state["sent"]:
+                    return
+                state["sent"] = True
+            if timer[0] is not None:
+                timer[0].cancel()
+            self._mark_worker_unblocked(worker)
+            try:
+                if timed_out:
+                    worker.send(("reply", req_id, False,
+                                 GetTimeoutError("get() timed out")))
+                    return
+                entries = []
+                for r, fut in zip(refs, futures):
+                    exc = fut.exception()
+                    if exc is not None:
+                        entries.append(("error", exc))
+                    else:
+                        with self._lock:
+                            entries.append(self._object_entry_payload(r.id))
+                worker.send(("reply", req_id, True, entries))
+            except Exception as e:  # noqa: BLE001
+                try:
+                    worker.send(("reply", req_id, False, e))
+                except Exception:
+                    pass
+
+        def on_done(_fut) -> None:
+            with slock:
+                state["done"] += 1
+                ready = state["done"] >= n
+            if ready:
+                finalize(False)
+
+        if timeout is not None:
+            timer[0] = threading.Timer(timeout, lambda: finalize(True))
+            timer[0].daemon = True
+            timer[0].start()
+        if n == 0:
+            finalize(False)
+            return
+        for fut in futures:
+            fut.add_done_callback(on_done)
+
+    def _handle_wait_async(self, worker: WorkerHandle, msg: tuple) -> None:
+        """Worker wait RPC via status watchers — no value materialization,
+        no parked thread."""
+        _, req_id, id_bins, num_returns, timeout = msg
+        oids = [ObjectID(b) for b in id_bins]
+        if num_returns > len(oids):
+            worker.send(("reply", req_id, False, ValueError(
+                "num_returns exceeds number of refs")))
+            return
+        self._mark_worker_blocked(worker)
+        state = {"sent": False}
+        slock = threading.Lock()
+        timer: List[Optional[threading.Timer]] = [None]
+        registered: List[tuple] = []  # (oid, callback, created_entry)
+
+        def done_ids():
+            out = []
+            for oid in oids:
+                e = self._objects.get(oid)
+                if e is not None and e.status in (_ObjStatus.READY,
+                                                  _ObjStatus.FAILED):
+                    out.append(oid)
+            return out
+
+        def try_finish(force: bool = False) -> None:
+            with self._lock:
+                done = done_ids()
+                if len(done) < num_returns and not force:
+                    return
+            with slock:
+                if state["sent"]:
+                    return
+                state["sent"] = True
+            if timer[0] is not None:
+                timer[0].cancel()
+            # Drop our watcher closures (and any phantom PENDING entries
+            # this wait itself created for never-seen ids) so early-satisfied
+            # or timed-out waits don't leak per-call state.
+            with self._lock:
+                for oid, cb, created in registered:
+                    entry = self._objects.get(oid)
+                    if entry is None:
+                        continue
+                    try:
+                        entry.watchers.remove(cb)
+                    except ValueError:
+                        pass
+                    if (created and entry.status == _ObjStatus.PENDING
+                            and not entry.watchers and not entry.futures
+                            and not entry.waiting_tasks
+                            and entry.creating_task is None):
+                        del self._objects[oid]
+            self._mark_worker_unblocked(worker)
+            try:
+                worker.send(("reply", req_id, True,
+                             [oid.binary() for oid in done[:num_returns]]))
+            except Exception:
+                pass
+
+        if timeout is not None:
+            timer[0] = threading.Timer(timeout, lambda: try_finish(True))
+            timer[0].daemon = True
+            timer[0].start()
+        with self._lock:
+            done_now = set(done_ids())
+            for oid in oids:
+                if oid in done_now:
+                    continue
+                created = oid not in self._objects
+                entry = self._objects.setdefault(oid, _ObjectEntry())
+                cb = lambda: try_finish(False)  # noqa: E731
+                entry.watchers.append(cb)
+                registered.append((oid, cb, created))
+        try_finish(False)
+
     def _handle_worker_rpc(self, worker: WorkerHandle, msg: tuple) -> None:
         kind, req_id = msg[0], msg[1]
         try:
-            if kind == "get":
-                _, _, id_bins, timeout = msg
-                refs = [ObjectRef(ObjectID(b), _register=False) for b in id_bins]
-                self._mark_worker_blocked(worker)
-                try:
-                    futures = [self.object_future(r) for r in refs]
-                    deadline = (None if timeout is None
-                                else time.monotonic() + timeout)
-                    entries = []
-                    for r, fut in zip(refs, futures):
-                        remaining = (None if deadline is None
-                                     else max(0.0, deadline - time.monotonic()))
-                        try:
-                            fut.result(timeout=remaining)
-                            with self._lock:
-                                entries.append(self._object_entry_payload(r.id))
-                        except TimeoutError:
-                            raise GetTimeoutError("get() timed out") from None
-                        except Exception as e:  # noqa: BLE001
-                            entries.append(("error", e))
-                    worker.send(("reply", req_id, True, entries))
-                finally:
-                    self._mark_worker_unblocked(worker)
-            elif kind == "wait":
-                _, _, id_bins, num_returns, timeout = msg
-                refs = [ObjectRef(ObjectID(b), _register=False) for b in id_bins]
-                self._mark_worker_blocked(worker)
-                try:
-                    ready, _ = self.wait(refs, num_returns, timeout)
-                finally:
-                    self._mark_worker_unblocked(worker)
-                worker.send(("reply", req_id, True, [r.id.binary() for r in ready]))
-            elif kind == "put":
+            if kind == "put":
                 _, _, oid_bin, entry = msg
                 oid = ObjectID(oid_bin)
                 if entry[0] == "inline":

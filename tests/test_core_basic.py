@@ -185,3 +185,49 @@ def test_cluster_resources(rt_shared):
     rt = rt_shared
     res = rt.cluster_resources()
     assert res.get("CPU", 0) >= 4
+
+
+def test_worker_wait_num_returns_validation(rt_init):
+    """A worker-side wait with num_returns > len(refs) must error, not
+    hang (regression: the async wait RPC dropped the validation)."""
+    import ray_tpu as rt
+
+    @rt.remote
+    def inner():
+        return 1
+
+    @rt.remote
+    def waiter():
+        ref = inner.remote()
+        try:
+            rt.wait([ref], num_returns=2, timeout=5)
+            return "no-error"
+        except ValueError:
+            return "value-error"
+
+    assert rt.get(waiter.remote(), timeout=30) == "value-error"
+
+
+def test_worker_wait_timeout_returns_partial(rt_init):
+    """wait() from a worker with a timeout returns the ready subset."""
+    import time as _time
+
+    import ray_tpu as rt
+
+    @rt.remote
+    def fast():
+        return "f"
+
+    @rt.remote
+    def slow():
+        _time.sleep(8)
+        return "s"
+
+    @rt.remote
+    def waiter():
+        refs = [fast.remote(), slow.remote()]
+        ready, not_ready = rt.wait(refs, num_returns=2, timeout=1.5)
+        return len(ready), len(not_ready)
+
+    n_ready, n_not = rt.get(waiter.remote(), timeout=30)
+    assert n_ready == 1 and n_not == 1
