@@ -33,14 +33,19 @@ class Cluster:
                  resources: Optional[Dict[str, float]] = None,
                  object_store_memory: Optional[int] = None,
                  topology: Optional[dict] = None,
-                 labels: Optional[dict] = None) -> NodeID:
+                 labels: Optional[dict] = None,
+                 remote: Optional[bool] = None) -> NodeID:
+        """``remote=True`` runs the node as a separate OS-process daemon
+        (its own worker pool + shm store, attached over TCP) — the
+        multi-host path; default in-process node managers simulate
+        multi-node cheaply (reference: Cluster.add_node raylets)."""
         node_resources = {"CPU": float(num_cpus)}
         if num_tpus:
             node_resources["TPU"] = float(num_tpus)
         node_resources.update(resources or {})
         node_id = self.runtime.add_node(
             node_resources, object_store_memory=object_store_memory,
-            labels=labels, topology=topology,
+            labels=labels, topology=topology, remote=remote,
         )
         self._nodes.append(node_id)
         return node_id
@@ -101,12 +106,26 @@ class NodeKiller:
                 if nid != self.cluster.head_node_id]
 
     def kill_one(self) -> Optional[NodeID]:
-        """Kill one random non-head node now; returns its id (or None)."""
+        """Kill one random non-head node now; returns its id (or None).
+
+        Daemon-backed nodes are SIGKILLed (a real host crash: the driver
+        notices via connection EOF, no cooperative teardown); in-process
+        nodes go through the simulated removal path.
+        """
         victims = self._victims()
         if not victims:
             return None
         node_id = self._rng.choice(victims)
-        self.cluster.remove_node(node_id)
+        node = self.cluster.runtime.scheduler.get_node(node_id)
+        if node is not None and getattr(node, "is_remote", False):
+            try:
+                node.process.kill()
+            except Exception:
+                self.cluster.remove_node(node_id)
+            if node_id in self.cluster._nodes:
+                self.cluster._nodes.remove(node_id)
+        else:
+            self.cluster.remove_node(node_id)
         self.killed.append(node_id)
         return node_id
 

@@ -98,6 +98,10 @@ _define("worker_register_timeout_s", int, 30,
         "Seconds to wait for a spawned worker process to register.")
 _define("prestart_workers", bool, True,
         "Pre-start the worker pool at node start instead of on demand.")
+_define("node_daemons", bool, False,
+        "Run each node as its own OS-process daemon (worker pool + shm "
+        "store) attached over TCP, instead of in-process node managers. "
+        "Reference: one raylet process per host.")
 _define("idle_worker_killing_time_ms", int, 60_000,
         "Idle time before surplus workers above the pool floor are reaped.")
 

@@ -1,0 +1,182 @@
+"""Per-host node daemon: worker pool + shm object store in their own
+OS process, attached to the driver over TCP.
+
+Reference analog: the raylet (``src/ray/raylet/main.cc`` /
+``node_manager.h``) — the per-node daemon that owns the plasma store and
+the worker processes while cluster metadata lives elsewhere. Division of
+labor here (driver-side scheduling is retained, see
+``remote_node.RemoteNode``):
+
+  daemon (this process)          driver
+  ---------------------          ------
+  spawns/reaps worker procs      picks nodes + leases workers (metadata
+  hosts the shm arena store        mirrors updated by daemon events)
+  relays worker pipe traffic     ownership plane: objects/lineage/
+  serves chunked object            refcounts/actors
+  push/pull (DCN data plane)     placement-group atomicity
+  heartbeats to control store
+
+Launch: ``python -m ray_tpu.core.node_daemon --driver ADDR:PORT ...``.
+The daemon dials the driver's cluster listener, registers, and then
+serves frames until the connection drops (driver death => exit) or a
+``shutdown`` frame arrives. Killing this process is the node-failure
+chaos mode: the driver sees EOF and runs its node-death path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import threading
+from typing import Dict, Optional
+
+from .config import config
+from .ids import NodeID, WorkerID
+from .node_protocol import ChunkAssembler, FrameConn, chunk_frames
+from .object_store import SharedMemoryStore
+from .worker_pool import WorkerPool
+
+
+class NodeDaemon:
+    def __init__(self, node_id: NodeID, driver_addr: str,
+                 object_store_memory: Optional[int] = None,
+                 env: Optional[dict] = None,
+                 num_workers: int = 0):
+        self.node_id = node_id
+        self.store = SharedMemoryStore(node_id, object_store_memory)
+        host, port = driver_addr.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)), timeout=30)
+        sock.settimeout(None)  # connect timeout only; recv blocks forever
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.conn = FrameConn(sock)
+        self._assembler = ChunkAssembler()
+        self._put_meta: Dict[int, tuple] = {}
+        # The pool's message handler relays every worker message to the
+        # driver verbatim — the ownership plane lives there.
+        self.pool = WorkerPool(
+            node_id, size=max(1, num_workers),
+            message_handler=self._relay_from_worker,
+            on_worker_death=self._on_worker_death,
+            env=env,
+        )
+        self._stopped = threading.Event()
+
+    # -- worker plane ------------------------------------------------------
+    def _relay_from_worker(self, worker, msg) -> None:
+        self.conn.send(("from_worker", worker.worker_id.binary(), msg))
+
+    def _on_worker_death(self, worker) -> None:
+        self.conn.send(("worker_dead", worker.worker_id.binary()))
+
+    # -- main loop ---------------------------------------------------------
+    def run(self) -> None:
+        self.conn.send(("register_node", self.node_id.binary(), os.getpid()))
+        try:
+            while not self._stopped.is_set():
+                msg = self.conn.recv()
+                self._handle(msg)
+        except EOFError:
+            pass  # driver gone: fall through to teardown
+        finally:
+            self.shutdown()
+
+    def _handle(self, msg: tuple) -> None:
+        kind = msg[0]
+        if kind == "spawn_worker":
+            token = msg[1] if len(msg) > 1 else 0
+            handle = self.pool._start_worker()
+            self.conn.send(
+                ("worker_started", handle.worker_id.binary(), token))
+        elif kind == "kill_worker":
+            handle = self.pool.get(WorkerID(msg[1]))
+            if handle is not None:
+                handle.kill()
+        elif kind == "to_worker":
+            _, wid_bin, payload = msg
+            handle = self.pool.get(WorkerID(wid_bin))
+            if handle is not None:
+                handle.send(payload)
+        elif kind == "store_put_chunk":
+            _, req_id, seq, total, data = msg
+            frame = self._assembler.add(req_id, seq, total, data)
+            if frame is not None:
+                oid_bin = self._put_meta.pop(req_id)
+                try:
+                    from .ids import ObjectID
+
+                    self.store.put_bytes(ObjectID(oid_bin), frame)
+                    self.conn.send(("reply", req_id, True, len(frame)))
+                except Exception as e:  # noqa: BLE001
+                    self.conn.send(("reply", req_id, False, e))
+        elif kind == "store_put_begin":
+            _, req_id, oid_bin = msg
+            self._put_meta[req_id] = oid_bin
+        elif kind == "store_get":
+            _, req_id, oid_bin = msg
+            from .ids import ObjectID
+
+            try:
+                buf = self.store.get_buffer(ObjectID(oid_bin))
+                payload = bytes(buf)
+                for frame in chunk_frames("chunk", req_id, payload):
+                    self.conn.send(frame)
+            except Exception as e:  # noqa: BLE001
+                self.conn.send(("reply", req_id, False, e))
+        elif kind == "store_register":
+            _, req_id, oid_bin, size = msg
+            from .ids import ObjectID
+
+            try:
+                self.store.register_external(ObjectID(oid_bin), size)
+                self.conn.send(("reply", req_id, True, None))
+            except Exception as e:  # noqa: BLE001
+                self.conn.send(("reply", req_id, False, e))
+        elif kind == "store_delete":
+            from .ids import ObjectID
+
+            self.store.delete(ObjectID(msg[1]))
+        elif kind == "store_stats":
+            _, req_id = msg
+            self.conn.send(("reply", req_id, True, self.store.stats()))
+        elif kind == "shutdown":
+            self._stopped.set()
+
+    def shutdown(self) -> None:
+        self._stopped.set()
+        try:
+            self.pool.shutdown()
+        finally:
+            try:
+                self.store.destroy()
+            except Exception:
+                pass
+            self.conn.close()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="ray_tpu node daemon")
+    parser.add_argument("--driver", required=True,
+                        help="driver cluster listener host:port")
+    parser.add_argument("--node-id", required=True, help="node id hex")
+    parser.add_argument("--store-memory", type=int, default=0)
+    parser.add_argument("--num-workers", type=int, default=2)
+    parser.add_argument("--env-json", default="{}",
+                        help="worker env vars as a JSON object")
+    args = parser.parse_args(argv)
+
+    import json
+
+    env = json.loads(args.env_json)
+    daemon = NodeDaemon(
+        NodeID.from_hex(args.node_id), args.driver,
+        object_store_memory=args.store_memory or None,
+        env=env, num_workers=args.num_workers,
+    )
+    daemon.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

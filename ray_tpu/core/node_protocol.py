@@ -1,0 +1,96 @@
+"""Driver <-> node-daemon wire protocol: length-prefixed pickle frames.
+
+Reference analog: the gRPC services between the driver/GCS and each raylet
+(``src/ray/protobuf/node_manager.proto``) and the chunked object transfer
+of the object manager (``object_manager.proto``, 5 MiB chunks) — here one
+duplex TCP connection per daemon carries control frames and chunked object
+push/pull (DCN plane). Python pickle framing keeps the protocol in one
+place; the latency-critical intra-host plane stays on worker pipes + shm.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Callable, Optional
+
+# Object payloads are cut into chunks of this size so one huge object
+# cannot head-of-line-block control frames for seconds (reference:
+# ObjectManager chunk size, object_manager.h).
+CHUNK_SIZE = 4 * 1024 * 1024
+
+
+class FrameConn:
+    """Thread-safe framed pickle connection over a socket."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._recv_lock = threading.Lock()
+        self.closed = False
+
+    def send(self, msg: Any) -> bool:
+        blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        try:
+            with self._send_lock:
+                self._sock.sendall(struct.pack("<Q", len(blob)) + blob)
+            return True
+        except OSError:
+            self.closed = True
+            return False
+
+    def recv(self) -> Any:
+        with self._recv_lock:
+            header = self._recv_exact(8)
+            (n,) = struct.unpack("<Q", header)
+            return pickle.loads(self._recv_exact(n))
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        remaining = n
+        while remaining:
+            b = self._sock.recv(min(remaining, 1 << 20))
+            if not b:
+                self.closed = True
+                raise EOFError("connection closed")
+            chunks.append(b)
+            remaining -= len(b)
+        return b"".join(chunks)
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def chunk_frames(kind: str, req_id: int, payload: bytes):
+    """Split an object payload into ``(kind, req_id, seq, total, bytes)``
+    frames (always at least one, so zero-byte objects round-trip)."""
+    total = max(1, -(-len(payload) // CHUNK_SIZE))
+    for seq in range(total):
+        yield (kind, req_id, seq, total,
+               payload[seq * CHUNK_SIZE:(seq + 1) * CHUNK_SIZE])
+
+
+class ChunkAssembler:
+    """Reassembles chunked payloads per request id."""
+
+    def __init__(self):
+        self._parts: dict = {}
+
+    def add(self, req_id: int, seq: int, total: int,
+            data: bytes) -> Optional[bytes]:
+        parts = self._parts.setdefault(req_id, [None] * total)
+        parts[seq] = data
+        if all(p is not None for p in parts):
+            del self._parts[req_id]
+            return b"".join(parts)
+        return None

@@ -405,6 +405,14 @@ class ShmClient:
 
     def read(self, object_id: ObjectID, size: int,
              node_hex: Optional[str] = None) -> memoryview:
+        # Test hook: pretend cross-node arenas are unattachable (as on a
+        # real multi-host cluster) to force the network transfer path.
+        if (os.environ.get("RT_FORCE_OBJECT_TRANSFER") == "1"
+                and node_hex is not None
+                and self._node_id_hex is not None
+                and node_hex != self._node_id_hex):
+            raise LookupError(
+                f"arena {node_hex[:8]} is on another host")
         for arena in (self._arena_for(node_hex), self._arena):
             if arena is not None:
                 view = arena.get(object_id.binary())

@@ -214,18 +214,97 @@ class Runtime:
     def add_node(self, resources: Dict[str, float],
                  object_store_memory: Optional[int] = None,
                  labels: Optional[dict] = None,
-                 topology: Optional[dict] = None) -> NodeID:
+                 topology: Optional[dict] = None,
+                 remote: Optional[bool] = None) -> NodeID:
         node_id = NodeID.from_random()
-        node = NodeManager(
-            node_id, resources, self._handle_worker_message,
-            self._handle_worker_death, object_store_memory=object_store_memory,
-            env=self._env, labels=labels,
-        )
+        if remote is None:
+            remote = config().node_daemons
+        if remote:
+            from .remote_node import RemoteNode
+
+            self._ensure_cluster_listener()
+            node = RemoteNode(
+                node_id, resources, self._handle_worker_message,
+                self._handle_worker_death, self._on_daemon_node_death,
+                self._cluster_addr, self._accept_daemon_conn,
+                object_store_memory=object_store_memory,
+                env=self._env, labels=labels,
+                on_change=self.scheduler.notify,
+            )
+        else:
+            node = NodeManager(
+                node_id, resources, self._handle_worker_message,
+                self._handle_worker_death,
+                object_store_memory=object_store_memory,
+                env=self._env, labels=labels,
+            )
         node.start()
         self.scheduler.add_node(node, topology=topology)
         if hasattr(self, "placement_group_manager"):
             self.placement_group_manager.retry_pending()
         return node_id
+
+    # -- node-daemon attach plane (reference: raylet -> GCS registration) --
+    def _ensure_cluster_listener(self) -> None:
+        if getattr(self, "_cluster_listener", None) is not None:
+            return
+        import socket as socket_mod
+
+        from .node_protocol import FrameConn
+
+        srv = socket_mod.socket(socket_mod.AF_INET,
+                                socket_mod.SOCK_STREAM)
+        srv.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(64)
+        self._cluster_listener = srv
+        self._cluster_addr = "127.0.0.1:%d" % srv.getsockname()[1]
+        self._daemon_conns: Dict[bytes, object] = {}
+        self._daemon_cv = threading.Condition()
+
+        def accept_loop():
+            while True:
+                try:
+                    sock, _ = srv.accept()
+                except OSError:
+                    return
+                sock.setsockopt(socket_mod.IPPROTO_TCP,
+                                socket_mod.TCP_NODELAY, 1)
+                conn = FrameConn(sock)
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    # daemon died mid-handshake: drop IT, not the loop
+                    continue
+                if msg[0] != "register_node":
+                    conn.close()
+                    continue
+                with self._daemon_cv:
+                    self._daemon_conns[msg[1]] = conn
+                    self._daemon_cv.notify_all()
+
+        threading.Thread(target=accept_loop, daemon=True,
+                         name="rt-cluster-accept").start()
+
+    def _accept_daemon_conn(self, node_id: NodeID, timeout: float = 30.0):
+        deadline = time.monotonic() + timeout
+        with self._daemon_cv:
+            while node_id.binary() not in self._daemon_conns:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"node daemon {node_id.hex()[:8]} did not register")
+                self._daemon_cv.wait(remaining)
+            return self._daemon_conns.pop(node_id.binary())
+
+    def _on_daemon_node_death(self, node_id: NodeID) -> None:
+        """Connection to a daemon dropped => the host is gone (chaos or
+        crash): run the standard node-failure path."""
+        try:
+            self.gcs.mark_node_dead(node_id)
+        except Exception:
+            pass
+        self.remove_node(node_id)
 
     def remove_node(self, node_id: NodeID) -> None:
         """Simulated node failure: kills its workers and destroys its store.
@@ -371,19 +450,13 @@ class Runtime:
     def object_future(self, ref: ObjectRef) -> Future:
         fut: Future = Future()
         recover = False
+        ready = False
         with self._lock:
             entry = self._objects.get(ref.id)
             if entry is None:
                 entry = self._objects.setdefault(ref.id, _ObjectEntry())
             if entry.status == _ObjStatus.READY:
-                try:
-                    fut.set_result(self._materialize_value(ref.id))
-                except ObjectLostError:
-                    entry.status = _ObjStatus.LOST
-                    entry.location = None
-                    fut = Future()
-                    entry.futures.append(fut)
-                    recover = True
+                ready = True
             elif entry.status == _ObjStatus.FAILED:
                 fut.set_exception(entry.error)
             elif entry.status == _ObjStatus.LOST:
@@ -391,6 +464,19 @@ class Runtime:
                 recover = True
             else:
                 entry.futures.append(fut)
+        if ready:
+            # Materialize OUTSIDE the runtime lock: for daemon-backed
+            # nodes this is a chunked network pull that must not stall
+            # every other runtime operation.
+            try:
+                fut.set_result(self._materialize_value(ref.id))
+            except ObjectLostError:
+                with self._lock:
+                    entry.status = _ObjStatus.LOST
+                    entry.location = None
+                    fut = Future()
+                    entry.futures.append(fut)
+                recover = True
         if recover:
             self._recover_object(ref.id)
         return fut
@@ -939,6 +1025,13 @@ class Runtime:
                     worker.send(("reply", msg[1], False, e))
                 except Exception:
                     pass
+        elif kind == "fetch_object":
+            # Cross-host object pull: a blocking chunked transfer that must
+            # NOT run on the node's single message-relay thread (it would
+            # queue task completions behind a multi-second copy). Bounded
+            # executor; fetches don't depend on each other, so the cap
+            # cannot deadlock.
+            self._fetch_pool().submit(self._handle_worker_rpc, worker, msg)
         elif kind in ("put", "submit", "kill_actor", "cancel", "get_actor"):
             # Quick, non-blocking RPCs run inline on this worker's reader
             # thread (ordering preserved, no thread churn). Blocking
@@ -970,66 +1063,94 @@ class Runtime:
                 self._mark_ready(oid, ("shm", record.node.node_id, size))
         self._decrement_arg_pins(spec)
 
+    def _fetch_pool(self):
+        pool = getattr(self, "_fetch_executor", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = ThreadPoolExecutor(max_workers=8,
+                                      thread_name_prefix="rt-fetch")
+            self._fetch_executor = pool
+        return pool
+
     def _handle_get_async(self, worker: WorkerHandle, msg: tuple) -> None:
-        """Worker get RPC without a parked thread: reply is assembled by a
-        completion callback on the last future (timeout via Timer)."""
+        """Worker get RPC without a parked thread: entry-status watchers
+        assemble the reply of shm-pointer/inline entries when the last
+        object completes (no value materialization on the head — the
+        worker resolves the pointers; timeout via Timer)."""
         _, req_id, id_bins, timeout = msg
-        refs = [ObjectRef(ObjectID(b), _register=False) for b in id_bins]
+        oids = [ObjectID(b) for b in id_bins]
         self._mark_worker_blocked(worker)
-        try:
-            futures = [self.object_future(r) for r in refs]
-        except Exception:
-            self._mark_worker_unblocked(worker)
-            raise
-        n = len(futures)
-        state = {"done": 0, "sent": False}
+        state = {"sent": False}
         slock = threading.Lock()
         timer: List[Optional[threading.Timer]] = [None]
+        registered: List[tuple] = []
 
-        def finalize(timed_out: bool) -> None:
+        def all_done_locked() -> bool:
+            for oid in oids:
+                e = self._objects.get(oid)
+                if e is None or e.status not in (_ObjStatus.READY,
+                                                 _ObjStatus.FAILED):
+                    return False
+            return True
+
+        def cleanup_locked() -> None:
+            for oid, cb in registered:
+                entry = self._objects.get(oid)
+                if entry is not None:
+                    try:
+                        entry.watchers.remove(cb)
+                    except ValueError:
+                        pass
+
+        def try_finish(timed_out: bool = False) -> None:
+            with self._lock:
+                if not timed_out and not all_done_locked():
+                    return
             with slock:
                 if state["sent"]:
                     return
                 state["sent"] = True
             if timer[0] is not None:
                 timer[0].cancel()
+            with self._lock:
+                cleanup_locked()
+                entries = None
+                if not timed_out:
+                    entries = []
+                    for oid in oids:
+                        payload = self._object_entry_payload(oid)
+                        entries.append(payload if payload is not None
+                                       else ("error",
+                                             ObjectLostError(oid)))
             self._mark_worker_unblocked(worker)
             try:
                 if timed_out:
                     worker.send(("reply", req_id, False,
                                  GetTimeoutError("get() timed out")))
-                    return
-                entries = []
-                for r, fut in zip(refs, futures):
-                    exc = fut.exception()
-                    if exc is not None:
-                        entries.append(("error", exc))
-                    else:
-                        with self._lock:
-                            entries.append(self._object_entry_payload(r.id))
-                worker.send(("reply", req_id, True, entries))
-            except Exception as e:  # noqa: BLE001
-                try:
-                    worker.send(("reply", req_id, False, e))
-                except Exception:
-                    pass
-
-        def on_done(_fut) -> None:
-            with slock:
-                state["done"] += 1
-                ready = state["done"] >= n
-            if ready:
-                finalize(False)
+                else:
+                    worker.send(("reply", req_id, True, entries))
+            except Exception:
+                pass
 
         if timeout is not None:
-            timer[0] = threading.Timer(timeout, lambda: finalize(True))
+            timer[0] = threading.Timer(timeout, lambda: try_finish(True))
             timer[0].daemon = True
             timer[0].start()
-        if n == 0:
-            finalize(False)
-            return
-        for fut in futures:
-            fut.add_done_callback(on_done)
+        recover: List[ObjectID] = []
+        with self._lock:
+            for oid in oids:
+                entry = self._objects.setdefault(oid, _ObjectEntry())
+                if entry.status in (_ObjStatus.READY, _ObjStatus.FAILED):
+                    continue
+                if entry.status == _ObjStatus.LOST:
+                    recover.append(oid)
+                cb = lambda: try_finish(False)  # noqa: E731
+                entry.watchers.append(cb)
+                registered.append((oid, cb))
+        for oid in recover:
+            self._recover_object(oid)
+        try_finish(False)
 
     def _handle_wait_async(self, worker: WorkerHandle, msg: tuple) -> None:
         """Worker wait RPC via status watchers — no value materialization,
@@ -1109,7 +1230,27 @@ class Runtime:
     def _handle_worker_rpc(self, worker: WorkerHandle, msg: tuple) -> None:
         kind, req_id = msg[0], msg[1]
         try:
-            if kind == "put":
+            if kind == "fetch_object":
+                # Cross-host object pull: return the raw frame, fetched
+                # from the owning node's store (for daemon-backed nodes
+                # this is the chunked TCP transfer).
+                _, _, oid_bin = msg
+                oid = ObjectID(oid_bin)
+                with self._lock:
+                    entry = self._objects.get(oid)
+                    location = entry.location if entry is not None else None
+                if location is None:
+                    raise ObjectLostError(oid, "no known location")
+                if location[0] == "memory":
+                    frame = self.memory_store.get(oid)
+                else:
+                    _, node_id, _size = location
+                    node = self.scheduler.get_node(node_id)
+                    if node is None:
+                        raise ObjectLostError(oid, "holding node is gone")
+                    frame = bytes(node.store.get_buffer(oid))
+                worker.send(("reply", req_id, True, frame))
+            elif kind == "put":
                 _, _, oid_bin, entry = msg
                 oid = ObjectID(oid_bin)
                 if entry[0] == "inline":
@@ -1338,6 +1479,23 @@ class Runtime:
             self._log_unsub()
         self.scheduler.shutdown()
         self.gcs.shutdown()
+        # Daemon-attach plane: close the listener (unblocks the accept
+        # thread) and any registered-but-unclaimed daemon connections.
+        listener = getattr(self, "_cluster_listener", None)
+        if listener is not None:
+            try:
+                listener.close()
+            except OSError:
+                pass
+            self._cluster_listener = None
+            with self._daemon_cv:
+                conns = list(self._daemon_conns.values())
+                self._daemon_conns.clear()
+            for conn in conns:
+                conn.close()
+        pool = getattr(self, "_fetch_executor", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
 
 
 def _local_chip_count() -> int:

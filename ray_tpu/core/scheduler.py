@@ -277,7 +277,12 @@ class ClusterScheduler:
                     if lease.spec.task_type == TaskType.ACTOR_CREATION_TASK:
                         # Actors get dedicated workers outside the pool cap
                         # (reference: WorkerPool dedicated-worker path).
+                        # Daemon-backed pools spawn asynchronously and
+                        # return None until the worker registers.
                         worker = node.pool.start_dedicated(lease.spec.actor_id)
+                        if worker is None:
+                            remaining.append(lease)
+                            continue
                     else:
                         worker = node.pool.try_pop_idle()
                         if worker is None:

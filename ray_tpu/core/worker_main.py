@@ -194,7 +194,16 @@ class WorkerRuntime:
         if kind == "shm":
             oid_bin, size = payload[0], payload[1]
             node_hex = payload[2] if len(payload) > 2 else None
-            view = self.shm.read(ObjectID(oid_bin), size, node_hex)
+            try:
+                view = self.shm.read(ObjectID(oid_bin), size, node_hex)
+            except Exception:
+                # Object lives on another HOST (arena not attachable):
+                # pull the bytes through the head, which fetches from the
+                # owning node daemon over its connection (the chunked DCN
+                # transfer path; reference: PullManager -> remote
+                # ObjectManager push).
+                frame = self._rpc("fetch_object", oid_bin)
+                return self.serializer.deserialize(frame)
             return self.serializer.deserialize(view)
         if kind == "error":
             return payload

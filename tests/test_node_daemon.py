@@ -1,0 +1,121 @@
+"""Daemon-backed nodes: separate OS processes over loopback TCP.
+
+VERDICT round-1 item 1 criteria: two daemons as real processes (no shared
+Python state), tasks/actors/objects/PGs/chaos across them, and a
+large object produced on host A gettable from host B via the network
+transfer path (forced with RT_FORCE_OBJECT_TRANSFER).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster_utils import Cluster, NodeKiller
+
+
+@pytest.fixture
+def daemon_cluster():
+    cluster = Cluster(head_node_args={
+        "num_cpus": 2,
+        "env": {"RT_FORCE_OBJECT_TRANSFER": "1"},
+    })
+    ids = [
+        cluster.add_node(num_cpus=2, resources={"zone_a": 1.0}, remote=True),
+        cluster.add_node(num_cpus=2, resources={"zone_b": 1.0}, remote=True),
+    ]
+    cluster.wait_for_nodes()
+    yield cluster, ids
+    cluster.shutdown()
+
+
+def test_daemons_are_separate_processes(daemon_cluster):
+    cluster, (n1, n2) = daemon_cluster
+    import os
+
+    node1 = cluster.runtime.scheduler.get_node(n1)
+    node2 = cluster.runtime.scheduler.get_node(n2)
+    assert node1.is_remote and node2.is_remote
+    pids = {node1.process.pid, node2.process.pid}
+    assert os.getpid() not in pids and len(pids) == 2
+    for pid in pids:
+        os.kill(pid, 0)  # raises if not actually running
+
+
+def test_tasks_actors_across_daemons(daemon_cluster):
+    cluster, _ = daemon_cluster
+
+    @rt.remote(resources={"zone_a": 0.1})
+    def square(x):
+        return x * x
+
+    assert rt.get([square.remote(i) for i in range(8)]) == [
+        i * i for i in range(8)]
+
+    @rt.remote(resources={"zone_b": 0.1})
+    class Counter:
+        def __init__(self):
+            self.x = 0
+
+        def add(self, k):
+            self.x += k
+            return self.x
+
+    c = Counter.remote()
+    assert rt.get([c.add.remote(2) for _ in range(5)])[-1] == 10
+
+
+def test_cross_daemon_object_transfer(daemon_cluster):
+    """>max_direct_call object produced on daemon A, consumed on daemon B.
+    RT_FORCE_OBJECT_TRANSFER makes workers treat other nodes' arenas as
+    unattachable (real multi-host), forcing the chunked TCP pull."""
+    cluster, _ = daemon_cluster
+
+    @rt.remote(resources={"zone_a": 0.1})
+    def produce(n):
+        return np.arange(n, dtype=np.int32)
+
+    @rt.remote(resources={"zone_b": 0.1})
+    def consume(arr):
+        return int(arr.sum())
+
+    n = 3 * 1024 * 1024 // 4  # ~3MB, multiple chunks at play driver-side
+    ref = produce.remote(n)
+    assert rt.get(consume.remote(ref)) == n * (n - 1) // 2
+    # the driver itself can pull it too (head-side network path)
+    assert len(rt.get(ref)) == n
+
+
+def test_placement_group_across_daemons(daemon_cluster):
+    cluster, _ = daemon_cluster
+    pg = rt.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=30)
+    nodes = {nid.hex() for nid in pg.bundle_nodes}
+    assert len(nodes) == 2
+    rt.remove_placement_group(pg)
+
+
+def test_daemon_chaos_sigkill_retries():
+    """SIGKILL one daemon mid-workload: driver sees EOF, fails the node,
+    and retries/reconstructs so the workload still completes."""
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    try:
+        cluster.add_node(num_cpus=2, remote=True)
+        cluster.add_node(num_cpus=2, remote=True)
+        cluster.wait_for_nodes()
+
+        @rt.remote(max_retries=4)
+        def slow(i):
+            time.sleep(0.3)
+            return i
+
+        refs = [slow.remote(i) for i in range(16)]
+        killer = NodeKiller(cluster, max_kills=1)
+        time.sleep(0.5)
+        killed = killer.kill_one()
+        assert killed is not None
+        results = rt.get(refs, timeout=120)
+        assert sorted(results) == list(range(16))
+    finally:
+        cluster.shutdown()
