@@ -14,6 +14,7 @@ from .api import (
     put,
     remote,
     shutdown,
+    on_ref_ready,
     wait,
 )
 from .exceptions import (
@@ -45,5 +46,6 @@ __all__ = [
     "WorkerID", "available_resources", "cancel", "cluster_resources", "get",
     "get_actor", "init", "is_initialized", "kill", "method", "nodes",
     "placement_group", "put", "remote", "remove_placement_group", "shutdown",
+    "on_ref_ready",
     "wait",
 ]

@@ -36,6 +36,7 @@ _DEFAULT_ACTOR_OPTIONS = dict(
     max_restarts=0,
     max_task_retries=0,
     max_concurrency=1,
+    concurrency_groups=None,
     name=None,
     namespace="default",
     lifetime=None,
@@ -184,6 +185,15 @@ class ActorClass:
             self._serializer, args, kwargs
         )
         opts = self._options
+        # Async actors (any coroutine method) default to high concurrency:
+        # calls interleave on one persistent event loop in the worker
+        # (reference: async actors default max_concurrency=1000).
+        import inspect as _inspect
+
+        if opts["max_concurrency"] == 1 and any(
+                _inspect.iscoroutinefunction(v)
+                for v in vars(self._cls).values()):
+            opts = dict(opts, max_concurrency=100)
         from .remote_function import _new_task_id
         from .ids import JobID
 
@@ -205,6 +215,7 @@ class ActorClass:
             actor_id=actor_id,
             max_restarts=opts["max_restarts"],
             max_concurrency=opts["max_concurrency"],
+            concurrency_groups=opts.get("concurrency_groups"),
             name=opts["name"] or "",
             runtime_env=dict(opts["runtime_env"]) if opts.get("runtime_env") else None,
         )
@@ -252,11 +263,17 @@ def get_actor(name: str, namespace: str = "default") -> ActorHandle:
     return serialization.loads(blob)
 
 
-def method(num_returns: int = 1):
-    """Decorator to set per-method defaults (reference: ``ray.method``)."""
+def method(num_returns: int = 1,
+           concurrency_group: str = None):
+    """Decorator to set per-method defaults (reference: ``ray.method``;
+    ``concurrency_group`` routes the method to one of the actor's named
+    execution groups — src/ray/core_worker/transport/
+    concurrency_group_manager.h)."""
 
     def decorator(fn):
         fn._num_returns = num_returns
+        if concurrency_group is not None:
+            fn._concurrency_group = concurrency_group
         return fn
 
     return decorator

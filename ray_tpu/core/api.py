@@ -59,6 +59,30 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
                               fetch_local=fetch_local)
 
 
+def on_ref_ready(ref: ObjectRef, callback) -> None:
+    """Invoke ``callback()`` once the ref is READY or FAILED.
+
+    In the driver this registers a zero-cost status watcher on the head
+    runtime (no value materialization, no parked thread) — the primitive
+    behind Serve's in-flight accounting. In workers it falls back to a
+    short waiter thread.
+    """
+    auto_init()
+    head = get_head_runtime()
+    if head is not None:
+        head.add_ready_watcher(ref.id, callback)
+        return
+    import threading
+
+    def waiter():
+        try:
+            get_runtime().wait([ref], num_returns=1, timeout=None)
+        finally:
+            callback()
+
+    threading.Thread(target=waiter, daemon=True).start()
+
+
 def kill(actor_handle, *, no_restart: bool = True) -> None:
     """Forcibly terminate an actor (reference: ``ray.kill``)."""
     head = get_head_runtime()

@@ -447,6 +447,16 @@ class Runtime:
                 ) from None
         return values[0] if single else values
 
+    def add_ready_watcher(self, oid: ObjectID, callback) -> None:
+        """Run ``callback()`` when the object reaches READY/FAILED (fires
+        immediately if it already has). Status-only: never materializes."""
+        with self._lock:
+            entry = self._objects.setdefault(oid, _ObjectEntry())
+            if entry.status not in (_ObjStatus.READY, _ObjStatus.FAILED):
+                entry.watchers.append(callback)
+                return
+        callback()
+
     def object_future(self, ref: ObjectRef) -> Future:
         fut: Future = Future()
         recover = False
@@ -635,6 +645,7 @@ class Runtime:
             "resolved_args": resolved,
             "num_returns": spec.num_returns,
             "max_concurrency": spec.max_concurrency,
+            "concurrency_groups": spec.concurrency_groups,
             "name": spec.describe(),
             "runtime_env": spec.runtime_env,
             "trace_ctx": spec.trace_ctx,

@@ -64,6 +64,8 @@ class TaskSpec:
     actor_seq_no: int = 0
     max_restarts: int = 0
     max_concurrency: int = 1
+    # method-group name -> max concurrent calls (reference: concurrency groups)
+    concurrency_groups: Optional[Dict[str, int]] = None
     name: str = ""
     runtime_env: Optional[dict] = None
     # (trace_id, span_id) of the submitting span — execution spans on the

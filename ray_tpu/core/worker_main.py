@@ -64,6 +64,11 @@ class WorkerRuntime:
         self._task_queue: "queue.Queue" = queue.Queue()
         self._actors: Dict[str, Any] = {}
         self._actor_executors: Dict[str, ThreadPoolExecutor] = {}
+        # (actor_hex, group_name) -> that group's own capped executor
+        self._group_executors: Dict[tuple, ThreadPoolExecutor] = {}
+        self._actor_method_groups: Dict[str, Dict[str, str]] = {}
+        # actor_hex -> persistent asyncio loop (async actors)
+        self._actor_loops: Dict[str, Any] = {}
         self._shutdown = threading.Event()
         self.current_task_id: Optional[TaskID] = None
         self._put_counter = 0
@@ -280,6 +285,28 @@ class WorkerRuntime:
                 maxc = payload.get("max_concurrency", 1)
                 if maxc > 1:
                     self._actor_executors[actor_hex] = ThreadPoolExecutor(maxc)
+                # Concurrency groups: each named group gets its OWN
+                # executor with its own cap; methods carry their group via
+                # the @method(concurrency_group=...) annotation (reference:
+                # transport/concurrency_group_manager.h).
+                groups = payload.get("concurrency_groups") or {}
+                for gname, limit in groups.items():
+                    self._group_executors[(actor_hex, gname)] = (
+                        ThreadPoolExecutor(max(1, int(limit))))
+                self._actor_method_groups[actor_hex] = {
+                    name: getattr(attr, "_concurrency_group")
+                    for name, attr in vars(cls).items()
+                    if hasattr(attr, "_concurrency_group")
+                }
+                # Async actors: ONE persistent event loop for the actor's
+                # lifetime; every coroutine call lands on it and awaits
+                # interleave (reference: fiber/asyncio per-actor loop,
+                # transport/fiber.h — NOT a throwaway loop per call).
+                import inspect as _inspect
+
+                if any(_inspect.iscoroutinefunction(v)
+                       for v in vars(cls).values()):
+                    self._actor_loops[actor_hex] = self._start_actor_loop()
                 result = None
             elif task_type == TaskType.ACTOR_TASK:
                 actor_hex = payload["actor_id"]
@@ -294,7 +321,14 @@ class WorkerRuntime:
                 if inspect.iscoroutine(result):
                     import asyncio
 
-                    result = asyncio.new_event_loop().run_until_complete(result)
+                    loop = self._actor_loops.get(actor_hex)
+                    if loop is None:
+                        loop = self._start_actor_loop()
+                        self._actor_loops[actor_hex] = loop
+                    # run on the actor's persistent loop: concurrent calls
+                    # (one executor slot each) interleave at awaits
+                    result = asyncio.run_coroutine_threadsafe(
+                        result, loop).result()
             else:
                 raise ValueError(f"bad task type {task_type}")
             results = self._store_results(
@@ -312,6 +346,28 @@ class WorkerRuntime:
                 restore_runtime_env(env_undo)
             self.current_task_id = prev_task
 
+    def _start_actor_loop(self):
+        """Persistent asyncio loop on its own thread (async actors)."""
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        t = threading.Thread(target=loop.run_forever, daemon=True,
+                             name="actor-asyncio-loop")
+        t.start()
+        return loop
+
+    def _pick_executor(self, payload) -> Optional[ThreadPoolExecutor]:
+        actor_hex = payload.get("actor_id")
+        if actor_hex is None:
+            return None
+        group = self._actor_method_groups.get(actor_hex, {}).get(
+            payload.get("method_name"))
+        if group is not None:
+            executor = self._group_executors.get((actor_hex, group))
+            if executor is not None:
+                return executor
+        return self._actor_executors.get(actor_hex)
+
     def run_task_loop(self) -> None:
         reader = threading.Thread(target=self._reader_loop, daemon=True,
                                   name="worker-reader")
@@ -322,9 +378,10 @@ class WorkerRuntime:
             if msg is None:
                 break
             payload = msg[2]
-            actor_hex = payload.get("actor_id")
-            executor = self._actor_executors.get(actor_hex) if actor_hex else None
-            if executor is not None and TaskType(payload["task_type"]) == TaskType.ACTOR_TASK:
+            executor = None
+            if TaskType(payload["task_type"]) == TaskType.ACTOR_TASK:
+                executor = self._pick_executor(payload)
+            if executor is not None:
                 executor.submit(self._execute_one, msg)
             else:
                 self._execute_one(msg)

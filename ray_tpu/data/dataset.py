@@ -1,11 +1,15 @@
-"""Dataset: distributed data over object-store blocks.
+"""Dataset: distributed data over object-store blocks, LAZY execution.
 
 Reference analog: ``python/ray/data/dataset.py:133`` — a Dataset is a list
 of block ObjectRefs; transforms (``map_batches`` :316, ``repartition``
 :776, ``random_shuffle`` :806, ``split`` :918, ``iter_batches`` :2390)
-run as tasks over blocks. Execution here is eager per-op (the reference's
-lazy ExecutionPlan optimizes stage fusion; the task-per-block model and
-API are the same), and ``iter_batches``/``to_jax`` feed device meshes with
+run as tasks over blocks. Like the reference's lazy
+``ExecutionPlan``/``Stage`` (``_internal/plan.py:69,41``), chained
+map-type transforms (map/map_batches/filter/flat_map) append STAGES to a
+plan and fuse into ONE task per block at execution time — a
+``map_batches().map_batches()`` chain reads and writes each block once.
+Consumption (iter/take/count/shuffle/...) triggers execution; results are
+cached on the plan. ``iter_batches``/``to_jax`` feed device meshes with
 host-side prefetch — the TPU input pipeline path.
 """
 
@@ -13,6 +17,7 @@ from __future__ import annotations
 
 import itertools
 import random as _random
+from dataclasses import dataclass, field
 from typing import (
     Any,
     Callable,
@@ -32,36 +37,102 @@ from ..core.object_ref import ObjectRef
 from .block import Block, BlockAccessor, build_blocks, concat_blocks, _key_of
 
 
+@dataclass(frozen=True)
+class _Stage:
+    """One fused-pipeline step (reference: _internal/plan.py Stage)."""
+
+    kind: str  # "batches" | "rows" | "filter" | "flat_map"
+    fn: Callable
+    batch_format: str = "numpy"
+    num_cpus: float = 1.0
+
+
+def _apply_stage(stage: _Stage, block):
+    if stage.kind == "batches":
+        acc = BlockAccessor.for_block(block)
+        return stage.fn(acc.to_format(stage.batch_format))
+    rows = BlockAccessor.for_block(block).to_rows()
+    if stage.kind == "rows":
+        return [stage.fn(r) for r in rows]
+    if stage.kind == "filter":
+        return [r for r in rows if stage.fn(r)]
+    if stage.kind == "flat_map":
+        out = []
+        for r in rows:
+            out.extend(stage.fn(r))
+        return out
+    raise ValueError(f"unknown stage kind {stage.kind!r}")
+
+
+def _fused_stages_task(stages, block):
+    """ALL fused stages over one block in one task — single read/write."""
+    for stage in stages:
+        block = _apply_stage(stage, block)
+    return block
+
+
+class ExecutionPlan:
+    """Input block refs + pending fused stages; executes once, caches.
+
+    Reference: ``data/_internal/plan.py:69`` ExecutionPlan with map-stage
+    fusion (every pending stage runs inside one task per block).
+    """
+
+    def __init__(self, input_blocks: List[ObjectRef],
+                 stages: Tuple[_Stage, ...] = ()):
+        self._input = list(input_blocks)
+        self.stages = tuple(stages)
+        self._executed: Optional[List[ObjectRef]] = None
+
+    def with_stage(self, stage: _Stage) -> "ExecutionPlan":
+        if self._executed is not None:
+            # already materialized: new lineage starts from the outputs
+            return ExecutionPlan(self._executed, (stage,))
+        return ExecutionPlan(self._input, self.stages + (stage,))
+
+    def execute(self) -> List[ObjectRef]:
+        if self._executed is None:
+            if not self.stages:
+                self._executed = list(self._input)
+            else:
+                num_cpus = max(s.num_cpus for s in self.stages)
+                task = remote(_fused_stages_task).options(num_cpus=num_cpus)
+                stages = self.stages
+                self._executed = [task.remote(stages, ref)
+                                  for ref in self._input]
+        return self._executed
+
+    def num_blocks(self) -> int:
+        return len(self._input)
+
+
 def _map_block_task(fn, block, batch_format):
     acc = BlockAccessor.for_block(block)
     batch = acc.to_format(batch_format)
     return fn(batch)
 
 
-def _rows_map_task(fn, block):
-    return [fn(r) for r in BlockAccessor.for_block(block).to_rows()]
-
-
-def _filter_task(fn, block):
-    return [r for r in BlockAccessor.for_block(block).to_rows() if fn(r)]
-
-
-def _flat_map_task(fn, block):
-    out = []
-    for r in BlockAccessor.for_block(block).to_rows():
-        out.extend(fn(r))
-    return out
-
-
 class Dataset:
-    def __init__(self, block_refs: List[ObjectRef],
-                 parallelism: Optional[int] = None):
-        self._blocks = list(block_refs)
-        self._parallelism = parallelism or len(block_refs)
+    def __init__(self, block_refs: Optional[List[ObjectRef]] = None,
+                 parallelism: Optional[int] = None,
+                 _plan: Optional[ExecutionPlan] = None):
+        self._plan = _plan if _plan is not None else ExecutionPlan(
+            list(block_refs or []))
+        self._parallelism = parallelism or self._plan.num_blocks()
+
+    @property
+    def _blocks(self) -> List[ObjectRef]:
+        """Materialized block refs (triggers plan execution, cached)."""
+        return self._plan.execute()
+
+    def _with_stage(self, stage: _Stage) -> "Dataset":
+        return Dataset(_plan=self._plan.with_stage(stage),
+                       parallelism=self._parallelism)
 
     # ------------------------------------------------------------ metadata
     def num_blocks(self) -> int:
-        return len(self._blocks)
+        # block count is invariant under fused map stages: no execution
+        return self._plan.num_blocks()
 
     def count(self) -> int:
         counter = remote(lambda b: BlockAccessor.for_block(b).num_rows())
@@ -89,21 +160,20 @@ class Dataset:
 
     # ------------------------------------------------------------ transforms
     def map(self, fn: Callable) -> "Dataset":
-        task = remote(_rows_map_task)
-        return Dataset([task.remote(fn, ref) for ref in self._blocks])
+        return self._with_stage(_Stage("rows", fn))
 
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
                     batch_size: Optional[int] = None,
                     compute: Optional[str] = None,
                     num_cpus: float = 1.0) -> "Dataset":
-        """Reference: dataset.py:316. ``compute="actors"`` reuses a pool of
-        actor processes (stateful/expensive-setup fns) instead of tasks."""
+        """Reference: dataset.py:316. Lazy: chained map_batches fuse into
+        one task per block. ``compute="actors"`` reuses a pool of actor
+        processes (stateful/expensive-setup fns) and is a fusion barrier."""
         if compute == "actors":
             return self._map_batches_actors(fn, batch_format, num_cpus)
-        task = remote(_map_block_task).options(num_cpus=num_cpus)
-        return Dataset(
-            [task.remote(fn, ref, batch_format) for ref in self._blocks]
-        )
+        return self._with_stage(
+            _Stage("batches", fn, batch_format=batch_format,
+                   num_cpus=num_cpus))
 
     def _map_batches_actors(self, fn, batch_format, num_cpus) -> "Dataset":
         from ..util.actor_pool import ActorPool
@@ -123,12 +193,10 @@ class Dataset:
         return Dataset([put(b) for b in results])
 
     def filter(self, fn: Callable) -> "Dataset":
-        task = remote(_filter_task)
-        return Dataset([task.remote(fn, ref) for ref in self._blocks])
+        return self._with_stage(_Stage("filter", fn))
 
     def flat_map(self, fn: Callable) -> "Dataset":
-        task = remote(_flat_map_task)
-        return Dataset([task.remote(fn, ref) for ref in self._blocks])
+        return self._with_stage(_Stage("flat_map", fn))
 
     def add_column(self, name: str, fn: Callable) -> "Dataset":
         def add(batch):

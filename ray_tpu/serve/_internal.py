@@ -106,37 +106,99 @@ class ServeController:
     """Controller actor: owns deployment state, reconciles replicas.
 
     Reference: serve/controller.py — ``deploy`` (:330) +
-    ``run_control_loop`` (:229). The loop runs inside actor method calls
-    (each ``reconcile`` tick) driven by the proxy/handles polling — or
-    explicitly by ``serve.run``.
+    ``run_control_loop`` (:229). The control loop runs INSIDE the actor
+    (``start_loop`` spawns it), so Serve keeps reconciling after driver
+    handles are GC'd; routers learn of replica-set changes through the
+    blocking ``listen_for_change`` long-poll (reference:
+    long_poll.py:184 LongPollHost snapshot-ids), not interval polling.
     """
 
     def __init__(self):
+        import threading
+
         self.deployments: Dict[str, DeploymentInfo] = {}
         self.replicas: Dict[str, List[Any]] = {}
         self._metrics: Dict[str, List[float]] = {}
         self._last_scale_up: Dict[str, float] = {}
         self._last_scale_down: Dict[str, float] = {}
+        self._lock = threading.RLock()
+        self._change = threading.Condition(self._lock)
+        self._versions: Dict[str, int] = {}
+        self._loop_stop = threading.Event()
+        self._loop_thread = None
+
+    def _bump_locked(self, name: str) -> None:
+        self._versions[name] = self._versions.get(name, 0) + 1
+        self._change.notify_all()
+
+    # -- control loop (runs inside the actor process) -----------------------
+    def start_loop(self, interval_s: float = 0.25) -> bool:
+        import threading
+
+        if self._loop_thread is not None:
+            return False
+
+        def loop():
+            while not self._loop_stop.wait(interval_s):
+                try:
+                    self.reconcile()
+                except Exception:
+                    pass
+
+        self._loop_thread = threading.Thread(
+            target=loop, daemon=True, name="serve-control-loop")
+        self._loop_thread.start()
+        return True
+
+    def stop_loop(self) -> bool:
+        self._loop_stop.set()
+        return True
 
     # -- deploy API ----------------------------------------------------------
     def deploy(self, info: DeploymentInfo) -> bool:
-        existing = self.deployments.get(info.name)
-        if existing is not None:
-            info.version = existing.version + 1
-        self.deployments[info.name] = info
-        self._reconcile_deployment(info.name, redeploy=existing is not None)
+        with self._lock:
+            existing = self.deployments.get(info.name)
+            if existing is not None:
+                info.version = existing.version + 1
+            self.deployments[info.name] = info
+            self._reconcile_deployment(info.name,
+                                       redeploy=existing is not None)
         return True
 
     def delete_deployment(self, name: str) -> bool:
-        info = self.deployments.pop(name, None)
-        for r in self.replicas.pop(name, []):
+        with self._lock:
+            info = self.deployments.pop(name, None)
+            victims = self.replicas.pop(name, [])
+            self._bump_locked(name)
+        for r in victims:
             try:
                 kill(r)
             except Exception:
                 pass
         return info is not None
 
+    # -- long-poll config push ----------------------------------------------
+    def listen_for_change(self, name: str, known_version: int,
+                          timeout_s: float = 30.0):
+        """Block until the replica set of ``name`` changes past
+        ``known_version`` (or timeout); returns (version, replicas).
+        Reference: LongPollHost.listen_for_change — routers hold one of
+        these calls open instead of polling on an interval."""
+        deadline = time.monotonic() + timeout_s
+        with self._change:
+            while self._versions.get(name, 0) <= known_version:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._change.wait(remaining)
+            return (self._versions.get(name, 0),
+                    list(self.replicas.get(name, [])))
+
     def list_deployments(self) -> Dict[str, dict]:
+        with self._lock:
+            return self._list_deployments_locked()
+
+    def _list_deployments_locked(self) -> Dict[str, dict]:
         return {
             name: {
                 "num_replicas": len(self.replicas.get(name, [])),
@@ -148,10 +210,17 @@ class ServeController:
         }
 
     def get_replicas(self, name: str) -> List[Any]:
-        return list(self.replicas.get(name, []))
+        with self._lock:
+            return list(self.replicas.get(name, []))
+
+    def get_replica_snapshot(self, name: str):
+        with self._lock:
+            return (self._versions.get(name, 0),
+                    list(self.replicas.get(name, [])))
 
     def get_deployment_names(self) -> List[str]:
-        return list(self.deployments)
+        with self._lock:
+            return list(self.deployments)
 
     # -- reconciliation ------------------------------------------------------
     def _target_replicas(self, name: str) -> int:
@@ -208,8 +277,13 @@ class ServeController:
     def reconcile(self) -> Dict[str, int]:
         """One control-loop tick (reference: run_control_loop body)."""
         out = {}
-        for name in list(self.deployments):
-            out[name] = self._reconcile_deployment(name)
+        with self._lock:
+            names = list(self.deployments)
+        for name in names:
+            with self._lock:
+                if name not in self.deployments:
+                    continue
+                out[name] = self._reconcile_deployment(name)
         return out
 
     def _reconcile_deployment(self, name: str, redeploy: bool = False) -> int:
@@ -224,7 +298,9 @@ class ServeController:
             current.clear()
         target = self._target_replicas(name)
         replica_cls = remote(_Replica)
+        changed = redeploy
         while len(current) < target:
+            changed = True
             opts = dict(info.ray_actor_options)
             actor = replica_cls.options(
                 max_concurrency=max(2, info.max_concurrent_queries),
@@ -233,70 +309,136 @@ class ServeController:
             current.append(actor)
         while len(current) > target:
             victim = current.pop()
+            changed = True
             try:
                 kill(victim)
             except Exception:
                 pass
+        if changed:
+            self._bump_locked(name)
         return len(current)
 
 
 class Router:
     """Client-side replica selection (reference: router.py ReplicaSet).
 
-    Round-robin with in-flight caps per replica; refreshes its replica
-    cache from the controller (the long-poll snapshot equivalent,
-    long_poll.py:67) when stale or empty.
+    Round-robin with ENFORCED per-replica in-flight caps: each assigned
+    request registers a completion watcher (``core.on_ref_ready``) that
+    releases the slot when the result lands, so a replica never holds
+    more than ``max_concurrent_queries`` outstanding requests
+    (router.py:62,221). Replica-set updates arrive through the
+    controller's blocking ``listen_for_change`` long-poll held open by a
+    background listener thread (long_poll.py:67 LongPollClient), not
+    interval polling.
     """
 
     def __init__(self, controller, deployment_name: str,
-                 max_concurrent_queries: int = 100,
-                 refresh_interval: float = 0.5):
+                 max_concurrent_queries: int = 100):
+        import threading
+
         self._controller = controller
         self._name = deployment_name
         self._max_cq = max_concurrent_queries
         self._replicas: List[Any] = []
+        self._version = -1
         self._rr = 0
-        self._inflight: Dict[int, int] = {}
-        self._last_refresh = 0.0
-        self._refresh_interval = refresh_interval
+        # keyed by replica actor id (stable across replica-set updates)
+        self._inflight: Dict[bytes, int] = {}
+        self._lock = threading.Lock()
+        self._slot_free = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._listener = threading.Thread(
+            target=self._listen_loop, daemon=True,
+            name=f"serve-router-{deployment_name}")
+        self._listener.start()
 
-    def _refresh(self, force: bool = False):
-        now = time.monotonic()
-        if (not force and self._replicas
-                and now - self._last_refresh < self._refresh_interval):
+    def _listen_loop(self):
+        """Long-poll: one blocking listen_for_change call held open."""
+        while not self._stop.is_set():
+            try:
+                version, replicas = get(
+                    self._controller.listen_for_change.remote(
+                        self._name, self._version),
+                    timeout=45,
+                )
+                with self._slot_free:
+                    if version != self._version:
+                        self._version = version
+                        self._replicas = replicas
+                        self._slot_free.notify_all()
+            except Exception:
+                if self._stop.is_set():
+                    return
+                time.sleep(0.5)
+
+    def _ensure_replicas(self, timeout: float = 5.0) -> None:
+        """First-use bootstrap: snapshot directly (the long-poll only
+        reports CHANGES past our version)."""
+        if self._replicas:
             return
-        self._replicas = get(
-            self._controller.get_replicas.remote(self._name)
-        )
-        self._last_refresh = now
+        try:
+            version, replicas = get(
+                self._controller.get_replica_snapshot.remote(self._name),
+                timeout=timeout,
+            )
+            with self._slot_free:
+                if version >= self._version and replicas:
+                    self._version = version
+                    self._replicas = replicas
+        except Exception:
+            pass
+
+    def stop(self):
+        self._stop.set()
 
     def assign(self, method: Optional[str], args, kwargs):
-        """Pick a replica with capacity; round-robin (router.py:221)."""
+        """Pick a replica with a free slot; block (condvar, woken by
+        completions and replica-set updates) when all are at capacity."""
         deadline = time.monotonic() + 30
+        self._ensure_replicas()
         while True:
-            self._refresh()
-            n = len(self._replicas)
-            if n:
+            chosen = None
+            with self._slot_free:
+                n = len(self._replicas)
                 for probe in range(n):
                     idx = (self._rr + probe) % n
-                    if self._inflight.get(idx, 0) < self._max_cq:
+                    replica = self._replicas[idx]
+                    key = replica._actor_id.binary()
+                    if self._inflight.get(key, 0) < self._max_cq:
                         self._rr = idx + 1
-                        replica = self._replicas[idx]
-                        self._inflight[idx] = self._inflight.get(idx, 0) + 1
-                        try:
-                            if method:
-                                return replica.call_method.remote(
-                                    method, args, kwargs
-                                )
-                            return replica.handle_request.remote(args, kwargs)
-                        finally:
-                            # In-flight decremented optimistically after
-                            # dispatch; precise tracking uses replica
-                            # metrics (collected by the controller).
-                            self._inflight[idx] -= 1
-            if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"no replica available for {self._name!r}"
-                )
-            self._refresh(force=True)
-            time.sleep(0.05)
+                        self._inflight[key] = self._inflight.get(key, 0) + 1
+                        chosen = (replica, key)
+                        break
+                if chosen is None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        detail = (f" (all at max_concurrent_queries="
+                                  f"{self._max_cq})" if n else "")
+                        raise RuntimeError(
+                            f"no replica available for "
+                            f"{self._name!r}{detail}")
+                    self._slot_free.wait(min(remaining, 1.0))
+            if chosen is None:
+                self._ensure_replicas()
+                continue
+            replica, key = chosen
+            try:
+                if method:
+                    ref = replica.call_method.remote(method, args, kwargs)
+                else:
+                    ref = replica.handle_request.remote(args, kwargs)
+            except Exception:
+                self._release(key)
+                raise
+
+            from ..core import on_ref_ready
+
+            on_ref_ready(ref, lambda k=key: self._release(k))
+            return ref
+
+    def _release(self, key: bytes) -> None:
+        with self._slot_free:
+            n = self._inflight.get(key, 0)
+            if n > 0:
+                self._inflight[key] = n - 1
+            self._slot_free.notify_all()

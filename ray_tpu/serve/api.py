@@ -26,41 +26,40 @@ from ._internal import (
 
 _CONTROLLER_NAME = "SERVE_CONTROLLER"
 _state: Dict[str, Any] = {"controller": None, "http_server": None,
-                          "reconciler": None, "stop": None}
+                          "routers": []}
 
 
 def start(http_port: int = 8000, http_host: str = "127.0.0.1",
-          detached: bool = False) -> None:
-    """Start the Serve instance (controller + proxy + reconcile loop)."""
+          detached: bool = True) -> None:
+    """Start the Serve instance: a DETACHED controller actor running its
+    own control loop (reference: run_control_loop inside the
+    ServeController actor, controller.py:229) + the HTTP proxy. Serve
+    survives driver-side handle GC — only serve.shutdown() stops it."""
     if _state["controller"] is not None:
         return
     controller_cls = remote(ServeController)
     controller = controller_cls.options(
-        name=_CONTROLLER_NAME, max_concurrency=16
+        name=_CONTROLLER_NAME, max_concurrency=64,
+        lifetime="detached" if detached else None,
     ).remote()
+    get(controller.start_loop.remote(), timeout=30)
     _state["controller"] = controller
-    stop = threading.Event()
-    _state["stop"] = stop
-
-    def reconcile_loop():
-        # Reference: run_control_loop (controller.py:229) — here driven by
-        # a driver-side thread ticking the controller actor.
-        while not stop.wait(0.25):
-            try:
-                get(controller.reconcile.remote(), timeout=30)
-            except Exception:
-                pass
-
-    t = threading.Thread(target=reconcile_loop, daemon=True,
-                         name="serve-reconciler")
-    t.start()
-    _state["reconciler"] = t
     _start_http_proxy(http_host, http_port)
 
 
 def shutdown() -> None:
-    if _state["stop"] is not None:
-        _state["stop"].set()
+    controller = _state.get("controller")
+    if controller is not None:
+        try:
+            get(controller.stop_loop.remote(), timeout=10)
+        except Exception:
+            pass
+    for router in _state.get("routers", []):
+        try:
+            router.stop()
+        except Exception:
+            pass
+    _state["routers"] = []
     server = _state.get("http_server")
     if server is not None:
         try:
@@ -92,6 +91,7 @@ class DeploymentHandle:
     def __init__(self, name: str, max_concurrent_queries: int = 100):
         self._name = name
         self._router = Router(_controller(), name, max_concurrent_queries)
+        _state.setdefault("routers", []).append(self._router)
 
     def remote(self, *args, **kwargs):
         return self._router.assign(None, args, kwargs)

@@ -134,3 +134,67 @@ def test_actor_pool_compute(rt_shared):
     assert sorted(int(x) for x in out.to_numpy()["data"]) == [
         i * 2 for i in range(16)
     ]
+
+
+def test_lazy_plan_fuses_map_stages(rt_shared):
+    """map_batches().map().filter() executes as ONE task per block
+    (reference: ExecutionPlan stage fusion, _internal/plan.py:69)."""
+    import ray_tpu.data as rtd
+    from ray_tpu.core.runtime import get_head_runtime
+
+    ds = rtd.from_items(list(range(64)), parallelism=4)
+    chained = (ds
+               .map_batches(lambda b: [x * 2 for x in b],
+                            batch_format="native")
+               .map(lambda r: r + 1)
+               .filter(lambda r: r % 4 == 1))
+    # nothing executed yet
+    assert chained._plan._executed is None
+    assert len(chained._plan.stages) == 3
+
+    head = get_head_runtime()
+    before = len(head._tasks)
+    out = sorted(chained.take_all())
+    submitted = len(head._tasks) - before
+    assert submitted == 4, f"expected 4 fused tasks, saw {submitted}"
+    assert out == sorted(x * 2 + 1 for x in range(64) if (x * 2 + 1) % 4 == 1)
+
+
+def test_shuffle_no_single_task_concat(rt_shared):
+    """random_shuffle runs as split tasks + per-output-block reduce tasks
+    (two-stage map/reduce, reference push_based_shuffle) — no task ever
+    sees the whole dataset."""
+    import ray_tpu.data as rtd
+    from ray_tpu.core.runtime import get_head_runtime
+
+    ds = rtd.from_items(list(range(400)), parallelism=8)
+    _ = ds._blocks  # materialize input
+    head = get_head_runtime()
+    before = len(head._tasks)
+    shuffled = ds.random_shuffle(seed=7)
+    out = shuffled.take_all()
+    submitted = len(head._tasks) - before
+    assert sorted(out) == list(range(400))
+    # 8 split tasks + 8 reduce tasks (+ take fetches, no monolithic concat)
+    assert submitted >= 16
+    assert shuffled.num_blocks() == 8
+
+
+def test_parquet_row_group_parallelism(rt_shared, tmp_path):
+    """One read task per parquet row group, not per file."""
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import ray_tpu.data as rtd
+
+    df = pd.DataFrame({"x": range(100), "y": [i * 0.5 for i in range(100)]})
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(df), path, row_group_size=25)
+    assert pq.ParquetFile(path).metadata.num_row_groups == 4
+
+    ds = rtd.read_parquet(path)
+    assert ds.num_blocks() == 4  # one block per row group from ONE file
+    assert ds.count() == 100
+    total = ds.sum(on="x")
+    assert total == sum(range(100))
