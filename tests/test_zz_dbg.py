@@ -1,0 +1,29 @@
+import time
+
+def test_async_dbg(rt_init):
+    rt = rt_init
+
+    t_def = time.monotonic()
+
+    @rt.remote
+    class AsyncGather:
+        def __init__(self):
+            self.t_init = time.monotonic()
+        async def ping(self):
+            return time.monotonic()
+
+    @rt.remote
+    class SyncActor:
+        def __init__(self):
+            pass
+        def ping(self):
+            return time.monotonic()
+
+    s = SyncActor.remote()
+    print("sync create+ping", rt.get(s.ping.remote(), timeout=60) - t_def)
+
+    t1 = time.monotonic()
+    a = AsyncGather.remote()
+    print("async ping", rt.get(a.ping.remote(), timeout=60) - t1)
+    t2 = time.monotonic()
+    print("async ping2", rt.get(a.ping.remote(), timeout=60) - t2)
