@@ -226,13 +226,22 @@ class RemoteWorkerPool:
             with self._lock:
                 self._inflight_spawns.pop(token, None)
 
+    def _claim_idle_locked(self, new_state: str, actor_id=None):
+        """Under self._lock: claim one registered idle worker into new_state."""
+        for w in self._workers.values():
+            if (w.state == RemoteWorkerHandle.IDLE and w.alive()
+                    and w._registered.is_set()):
+                w.state = new_state
+                if actor_id is not None:
+                    w.actor_id = actor_id
+                return w
+        return None
+
     def try_pop_idle(self) -> Optional[RemoteWorkerHandle]:
         with self._lock:
-            for w in self._workers.values():
-                if (w.state == RemoteWorkerHandle.IDLE and w.alive()
-                        and w._registered.is_set()):
-                    w.state = RemoteWorkerHandle.LEASED
-                    return w
+            w = self._claim_idle_locked(RemoteWorkerHandle.LEASED)
+            if w is not None:
+                return w
             plain_inflight = sum(
                 1 for a in self._inflight_spawns.values() if a is None)
             if len(self._alive()) + plain_inflight >= self.size:
@@ -241,9 +250,12 @@ class RemoteWorkerPool:
         return None  # lease retries when the worker registers
 
     def start_dedicated(self, actor_id) -> Optional[RemoteWorkerHandle]:
-        """First call requests the spawn and returns None; the scheduler
-        re-runs the lease when the worker registers and the second call
-        claims it."""
+        """Claim a prestarted idle worker for the actor when available
+        (reference: ``worker_pool.h:104`` PopWorker for actor-creation
+        tasks), refilling the pool with a fire-and-forget spawn. Otherwise
+        the first call requests a dedicated spawn and returns None; the
+        scheduler re-runs the lease when the worker registers and the
+        second call claims it."""
         with self._lock:
             handle = self._ready_dedicated.get(actor_id.binary())
             if handle is not None and handle._registered.is_set():
@@ -253,6 +265,10 @@ class RemoteWorkerPool:
                     a is not None and a.binary() == actor_id.binary()
                     for a in self._inflight_spawns.values()):
                 return None  # spawn (or registration) still in flight
+            w = self._claim_idle_locked(RemoteWorkerHandle.DEDICATED, actor_id)
+        if w is not None:
+            self._request_spawn()  # refill the pool (outside the lock)
+            return w
         self._request_spawn(actor_id)
         return None
 

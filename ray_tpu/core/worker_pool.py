@@ -82,6 +82,9 @@ class WorkerPool:
         self._workers: Dict[WorkerID, WorkerHandle] = {}
         self._lock = threading.RLock()
         self._stopped = threading.Event()
+        # Spawns decided but not yet inserted into _workers; counted against
+        # the pool cap so concurrent check-then-spawn paths can't overshoot.
+        self._pending_spawns = 0
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, prestart: bool = True) -> None:
@@ -127,17 +130,46 @@ class WorkerPool:
             self._on_worker_death(worker)
 
     # -- leasing (reference: PopWorker / PushWorker) -------------------------
+    def _claim_idle_locked(self, new_state: str, actor_id=None):
+        """Under self._lock: claim one registered idle worker into new_state."""
+        for w in self._workers.values():
+            if (w.state == WorkerHandle.IDLE and w.alive()
+                    and w._registered.is_set()):
+                w.state = new_state
+                if actor_id is not None:
+                    w.actor_id = actor_id
+                return w
+        return None
+
+    def _reserve_spawn_locked(self) -> bool:
+        """Under self._lock: reserve a spawn slot if the cap allows."""
+        if len(self._alive()) + self._pending_spawns < self.size:
+            self._pending_spawns += 1
+            return True
+        return False
+
+    def _spawn_reserved(self) -> WorkerHandle:
+        try:
+            handle = self._start_worker()
+        finally:
+            with self._lock:
+                self._pending_spawns -= 1
+        # A detached refill may lose the race with shutdown(): its snapshot
+        # of _workers predates this insert, so reap the straggler here.
+        if self._stopped.is_set():
+            handle.kill()
+        return handle
+
     def pop_idle(self, wait_timeout: float = 30.0) -> Optional[WorkerHandle]:
         deadline = time.monotonic() + wait_timeout
         while time.monotonic() < deadline:
             with self._lock:
-                for w in self._workers.values():
-                    if w.state == WorkerHandle.IDLE and w.alive() and w._registered.is_set():
-                        w.state = WorkerHandle.LEASED
-                        return w
-                have_capacity = len(self._alive()) < self.size
+                w = self._claim_idle_locked(WorkerHandle.LEASED)
+                if w is not None:
+                    return w
+                have_capacity = self._reserve_spawn_locked()
             if have_capacity:
-                handle = self._start_worker()
+                handle = self._spawn_reserved()
                 handle._registered.wait(timeout=wait_timeout)
                 with self._lock:
                     if handle.state == WorkerHandle.IDLE:
@@ -149,21 +181,17 @@ class WorkerPool:
 
     def try_pop_idle(self) -> Optional[WorkerHandle]:
         with self._lock:
-            for w in self._workers.values():
-                if w.state == WorkerHandle.IDLE and w.alive() and w._registered.is_set():
-                    w.state = WorkerHandle.LEASED
-                    return w
-            if len(self._alive()) < self.size:
-                pass_start = True
-            else:
+            w = self._claim_idle_locked(WorkerHandle.LEASED)
+            if w is not None:
+                return w
+            if not self._reserve_spawn_locked():
                 return None
-        if pass_start:
-            handle = self._start_worker()
-            handle._registered.wait(timeout=30)
-            with self._lock:
-                if handle.state == WorkerHandle.IDLE:
-                    handle.state = WorkerHandle.LEASED
-                    return handle
+        handle = self._spawn_reserved()
+        handle._registered.wait(timeout=30)
+        with self._lock:
+            if handle.state == WorkerHandle.IDLE:
+                handle.state = WorkerHandle.LEASED
+                return handle
         return None
 
     def return_worker(self, worker: WorkerHandle) -> None:
@@ -177,11 +205,23 @@ class WorkerPool:
             worker.actor_id = actor_id
 
     def start_dedicated(self, actor_id) -> WorkerHandle:
-        """Spawn a worker outside the pool cap, bound to an actor for life.
+        """Dedicate a worker to an actor for its lifetime.
 
-        Reference: WorkerPool starts dedicated workers for actor creation
-        tasks rather than consuming the idle pool.
+        Claims a prestarted idle worker when one is available (reference:
+        ``worker_pool.h:104`` PopWorker serves actor-creation tasks from
+        the cached pool) and refills the pool asynchronously, so actor
+        cold-start does not pay process spawn + jax import. Falls back to
+        a fresh spawn when the pool is empty.
         """
+        with self._lock:
+            claimed = self._claim_idle_locked(WorkerHandle.DEDICATED, actor_id)
+            refill = claimed is not None and not self._stopped.is_set() \
+                and self._reserve_spawn_locked()
+        if claimed is not None:
+            if refill:
+                threading.Thread(target=self._spawn_reserved, daemon=True,
+                                 name="rt-pool-refill").start()
+            return claimed
         handle = self._start_worker()
         with self._lock:
             handle.state = WorkerHandle.DEDICATED
