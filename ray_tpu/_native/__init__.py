@@ -54,12 +54,15 @@ def _load_lib():
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_uint64,
         ]
-        lib.rt_store_create_object.restype = ctypes.POINTER(ctypes.c_ubyte)
+        lib.rt_store_create_object.restype = ctypes.c_void_p
         lib.rt_store_create_object.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32),
         ]
         lib.rt_store_seal.restype = ctypes.c_int
         lib.rt_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_abort.restype = ctypes.c_int
+        lib.rt_store_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.rt_store_get.restype = ctypes.POINTER(ctypes.c_ubyte)
         lib.rt_store_get.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p,
@@ -99,6 +102,50 @@ class NativeStoreFull(NativeStoreError):
 class NativeStorePendingDelete(NativeStoreError):
     """Key was deleted while readers still pin the old extent; a new put
     for the same key must wait until the last reader releases."""
+
+
+class NativeStoreExists(NativeStoreError):
+    """Object already SEALED under this key — puts are idempotent, so
+    callers usually treat this as success."""
+
+
+class NativeStoreUnsealed(NativeStoreError):
+    """An unsealed reservation exists for this key (a prior writer died
+    between create and seal). The owner serializes same-key writes, so
+    it may abort() the wedged reservation and retry."""
+
+
+class _PinnedExtent:
+    """Owns one pin (refcount) on a sealed arena extent.
+
+    ``memoryview(pinned)`` exports a read-only buffer via ``__buffer__``
+    (PEP 688); every derived slice — including numpy arrays rebuilt from
+    out-of-band pickle buffers — keeps this object alive, and the pin is
+    released when the last one is collected. Deferred-free in the store
+    (``SLOT_PENDING_DELETE``) guarantees the extent is not reused while
+    pinned, so zero-copy values can safely outlive the object's deletion.
+    """
+
+    __slots__ = ("_store", "_key", "_arr", "_size")
+
+    def __init__(self, store: "NativeStore", key: bytes, ptr: int, size: int):
+        self._store = store
+        self._key = bytes(key)
+        self._size = size
+        self._arr = (ctypes.c_ubyte * max(size, 1)).from_address(ptr)
+
+    def __buffer__(self, flags):
+        # ctypes exports format "<B"; cast to "B" so consumers (pickle
+        # buffer loads, numpy frombuffer) accept it.
+        return memoryview(self._arr).cast("B").toreadonly()[: self._size]
+
+    def __del__(self):
+        store = getattr(self, "_store", None)
+        if store is not None and not store._closed:
+            try:
+                store._lib.rt_store_release(store._handle, self._key)
+            except Exception:
+                pass
 
 
 class NativeStore:
@@ -151,6 +198,48 @@ class NativeStore:
                 ptr, ctypes.POINTER(ctypes.c_ubyte * size.value)
             ).contents
         )
+
+    def get_pinned(self, key: bytes) -> Optional[memoryview]:
+        """Zero-copy READ-ONLY view whose pin is released automatically
+        when the last derived view (e.g. a numpy array deserialized out
+        of band) is garbage-collected — plasma-client buffer semantics.
+        """
+        size = ctypes.c_uint64()
+        ptr = self._lib.rt_store_get(self._handle, key, ctypes.byref(size))
+        if not ptr:
+            return None
+        addr = ctypes.cast(ptr, ctypes.c_void_p).value
+        return memoryview(_PinnedExtent(self, key, addr, size.value))
+
+    def create_object(self, key: bytes, size: int) -> memoryview:
+        """Reserve an extent and return a WRITABLE view into the arena;
+        call seal() after filling it (abort() on failure). This is the
+        zero-copy write path (reference: plasma Create/Seal)."""
+        err = ctypes.c_int32()
+        ptr = self._lib.rt_store_create_object(
+            self._handle, key, size, ctypes.byref(err))
+        if not ptr:
+            if err.value == -2:
+                raise NativeStoreFull("arena full")
+            if err.value == -3:
+                raise NativeStoreError("object table full")
+            if err.value == -5:
+                raise NativeStorePendingDelete(key.hex())
+            if err.value == -1:
+                raise NativeStoreExists(key.hex())
+            if err.value == -6:
+                raise NativeStoreUnsealed(key.hex())
+            raise NativeStoreError(f"create_object failed err={err.value}")
+        arr = (ctypes.c_ubyte * max(size, 1)).from_address(ptr)
+        return memoryview(arr).cast("B")[:size]
+
+    def seal(self, key: bytes) -> None:
+        rc = self._lib.rt_store_seal(self._handle, key)
+        if rc != 0:
+            raise NativeStoreError(f"seal failed rc={rc}")
+
+    def abort(self, key: bytes) -> None:
+        self._lib.rt_store_abort(self._handle, key)
 
     def release(self, key: bytes) -> None:
         self._lib.rt_store_release(self._handle, key)

@@ -312,22 +312,40 @@ int rt_store_put(void* handle, const uint8_t* key, const uint8_t* data,
   return 0;
 }
 
-// Reserve space for zero-copy writes: returns pointer to write into, or
-// null. Seal with rt_store_seal when done.
+/// Reserve space for zero-copy writes: returns pointer to write into, or
+// null with *err_out set (-1 sealed-exists, -2 arena full, -3 table
+// full, -4 lock error, -5 pending-delete, -6 unsealed reservation
+// exists — a prior writer died between create and seal; the owner may
+// rt_store_abort it and retry). Seal with rt_store_seal when done;
+// rt_store_abort frees an unsealed reservation.
 uint8_t* rt_store_create_object(void* handle, const uint8_t* key,
-                                uint64_t size) {
+                                uint64_t size, int32_t* err_out) {
   Store* s = static_cast<Store*>(handle);
   StoreHeader* h = header(s);
-  if (lock_robust(h) != 0) return nullptr;
+  *err_out = 0;
+  if (lock_robust(h) != 0) {
+    *err_out = -4;
+    return nullptr;
+  }
   Slot* slot = find_slot(s, key, true);
-  if (!slot || slot->state == SLOT_SEALED ||
+  if (!slot || slot->state == SLOT_SEALED || slot->state == SLOT_CREATED ||
       slot->state == SLOT_PENDING_DELETE) {
+    if (!slot) {
+      *err_out = -3;
+    } else if (slot->state == SLOT_PENDING_DELETE) {
+      *err_out = -5;
+    } else if (slot->state == SLOT_CREATED) {
+      *err_out = -6;
+    } else {
+      *err_out = -1;
+    }
     pthread_mutex_unlock(&h->mutex);
     return nullptr;
   }
   uint64_t actual = 0;
   uint64_t off = arena_alloc(s, size ? size : 1, &actual);
   if (off == UINT64_MAX) {
+    *err_out = -2;
     pthread_mutex_unlock(&h->mutex);
     return nullptr;
   }
@@ -339,6 +357,22 @@ uint8_t* rt_store_create_object(void* handle, const uint8_t* key,
   slot->state = SLOT_CREATED;
   pthread_mutex_unlock(&h->mutex);
   return reinterpret_cast<uint8_t*>(arena(s) + off);
+}
+
+// Free an unsealed reservation (failed write between create and seal).
+int rt_store_abort(void* handle, const uint8_t* key) {
+  Store* s = static_cast<Store*>(handle);
+  StoreHeader* h = header(s);
+  if (lock_robust(h) != 0) return -4;
+  Slot* slot = find_slot(s, key, false);
+  if (!slot || slot->state != SLOT_CREATED) {
+    pthread_mutex_unlock(&h->mutex);
+    return -1;
+  }
+  arena_free(s, slot->offset, slot->alloc_size);
+  slot->state = SLOT_TOMBSTONE;
+  pthread_mutex_unlock(&h->mutex);
+  return 0;
 }
 
 int rt_store_seal(void* handle, const uint8_t* key) {

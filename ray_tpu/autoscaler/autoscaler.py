@@ -66,7 +66,12 @@ class LoadMetrics:
 
     @classmethod
     def from_runtime(cls, runtime) -> "LoadMetrics":
-        """Snapshot a live runtime (the monitor's GCS poll equivalent)."""
+        """Snapshot a live runtime (the monitor's GCS poll equivalent).
+
+        Demands include queued/infeasible task leases AND the bundles of
+        pending placement groups — mesh claims lower to PG bundles of
+        TPU chips (``MeshClaim.to_bundles``), so a pending claim surfaces
+        as {"TPU": n} demands that bin-pack onto TPU-pod node types."""
         lm = cls()
         for node in runtime.scheduler.nodes():
             lm.update_node(node.node_id.hex(), node.ledger.total,
@@ -76,6 +81,12 @@ class LoadMetrics:
                        for l in runtime.scheduler._queue]
             demands += [dict(l.spec.resources)
                         for l in runtime.scheduler._infeasible]
+        pgm = getattr(runtime, "placement_group_manager", None)
+        if pgm is not None:
+            with pgm._lock:
+                for pg in pgm._groups.values():
+                    if pg.state in ("PENDING", "UNSCHEDULABLE"):
+                        demands += [dict(b) for b in pg.bundles]
         lm.set_pending_demands([d for d in demands if d])
         return lm
 

@@ -67,6 +67,115 @@ class FakeNodeProvider(NodeProvider):
             self.terminate_calls.append(node_id)
 
 
+class TPUPodAPI:
+    """Client surface of a TPU-VM pod-slice API (reference: the ``GCPTPU``
+    resource client, ``autoscaler/_private/gcp/node.py:547`` — create /
+    delete / list TPU nodes by acceleratorType). Subclass per cloud; the
+    mock below serves autoscaler logic and tests, matching how the
+    reference tests autoscaler e2e with a fake provider."""
+
+    def create_tpu(self, name: str, accelerator_type: str,
+                   labels: Optional[Dict[str, str]] = None) -> dict:
+        raise NotImplementedError
+
+    def delete_tpu(self, name: str) -> None:
+        raise NotImplementedError
+
+    def list_tpus(self) -> List[dict]:
+        raise NotImplementedError
+
+
+class MockTPUPodAPI(TPUPodAPI):
+    """In-memory TPU API: slices come up READY after ``ready_after``
+    polls (CREATING first, like real slice provisioning)."""
+
+    def __init__(self, ready_after: int = 0):
+        self._slices: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._ready_after = ready_after
+        self.create_calls: List[tuple] = []
+        self.delete_calls: List[str] = []
+
+    def create_tpu(self, name, accelerator_type, labels=None) -> dict:
+        with self._lock:
+            entry = {"name": name, "acceleratorType": accelerator_type,
+                     "state": "CREATING" if self._ready_after else "READY",
+                     "labels": dict(labels or {}), "polls": 0}
+            self._slices[name] = entry
+            self.create_calls.append((name, accelerator_type))
+            return dict(entry)
+
+    def delete_tpu(self, name) -> None:
+        with self._lock:
+            self._slices.pop(name, None)
+            self.delete_calls.append(name)
+
+    def list_tpus(self) -> List[dict]:
+        with self._lock:
+            out = []
+            for entry in self._slices.values():
+                if entry["state"] == "CREATING":
+                    entry["polls"] += 1
+                    if entry["polls"] >= self._ready_after:
+                        entry["state"] = "READY"
+                out.append(dict(entry))
+            return out
+
+
+class TPUPodProvider(NodeProvider):
+    """Maps autoscaler node types to TPU pod slices: one provider node =
+    one slice of the node type's ``topology["accelerator_type"]``
+    (reference: ``GCPTPUNode``, ``gcp/node.py:187`` + the ``tpu.yaml``
+    node type with ``acceleratorType: v2-8``). A pending mesh claim's
+    {"TPU": n} demand bin-packs onto these types, so claims trigger
+    slice scale-up."""
+
+    def __init__(self, api: TPUPodAPI, node_types: Dict[str, Any],
+                 name_prefix: str = "rt-tpu"):
+        self._api = api
+        self._types = node_types
+        self._prefix = name_prefix
+        self._counter = 0
+        self._lock = threading.Lock()
+
+    def accelerator_type_for(self, node_type: str) -> str:
+        nt = self._types[node_type]
+        topo = getattr(nt, "topology", None) or {}
+        acc = topo.get("accelerator_type") or topo.get("tpu_slice")
+        if not acc:
+            raise ValueError(
+                f"node type {node_type!r} has no "
+                f"topology['accelerator_type'] (e.g. 'v5e-8')")
+        return str(acc)
+
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        out = []
+        for s in self._api.list_tpus():
+            labels = s.get("labels", {})
+            out.append(NodeInstance(
+                s["name"], labels.get("rt-node-type", s["acceleratorType"]),
+                tags={"state": s["state"],
+                      "acceleratorType": s["acceleratorType"]},
+                running=s["state"] in ("CREATING", "READY"),
+            ))
+        return out
+
+    def create_node(self, node_type: str, count: int = 1) -> List[str]:
+        acc = self.accelerator_type_for(node_type)
+        ids = []
+        for _ in range(count):
+            with self._lock:
+                self._counter += 1
+                name = f"{self._prefix}-{node_type}-{self._counter}"
+            self._api.create_tpu(name, acc,
+                                 labels={"rt-node-type": node_type})
+            ids.append(name)
+        return ids
+
+    def terminate_node(self, node_id: str) -> None:
+        self._api.delete_tpu(node_id)
+
+
 class LocalNodeProvider(NodeProvider):
     """Backs provider nodes with real simulated cluster nodes.
 

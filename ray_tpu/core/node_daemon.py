@@ -43,8 +43,18 @@ class NodeDaemon:
     def __init__(self, node_id: NodeID, driver_addr: str,
                  object_store_memory: Optional[int] = None,
                  env: Optional[dict] = None,
-                 num_workers: int = 0):
+                 num_workers: int = 0,
+                 resources: Optional[dict] = None):
         self.node_id = node_id
+        # Self-registration payload: set when this daemon was started from
+        # a shell (``rt start --address=...``) rather than spawned by a
+        # driver — the head ADOPTS it on registration (reference:
+        # raylet → GCS node registration, services.py:1440 start_raylet).
+        self.self_register_info = (
+            {"self_register": True, "resources": dict(resources),
+             "num_workers": num_workers,
+             "store_memory": object_store_memory or 0}
+            if resources is not None else None)
         self.store = SharedMemoryStore(node_id, object_store_memory)
         host, port = driver_addr.rsplit(":", 1)
         sock = socket.create_connection((host, int(port)), timeout=30)
@@ -54,7 +64,10 @@ class NodeDaemon:
         self._assembler = ChunkAssembler()
         self._put_meta: Dict[int, tuple] = {}
         # The pool's message handler relays every worker message to the
-        # driver verbatim — the ownership plane lives there.
+        # driver verbatim — the ownership plane lives there. Exception:
+        # cross-node object pulls go PEER-TO-PEER through the pull
+        # manager (reference: PullManager/PushManager — raylets transfer
+        # directly, the GCS/driver is not a data-plane hop).
         self.pool = WorkerPool(
             node_id, size=max(1, num_workers),
             message_handler=self._relay_from_worker,
@@ -62,17 +75,52 @@ class NodeDaemon:
             env=env,
         )
         self._stopped = threading.Event()
+        # Serve objects on the interface that reaches the head — NOT
+        # loopback, or cross-HOST peers would dial themselves.
+        local_ip = self.conn._sock.getsockname()[0]
+        self.object_server = ObjectServer(self.store, host=local_ip)
+        self.pull_manager = PullManager(self)
+        self._locate_pending: Dict[int, "_LocateWaiter"] = {}
+        self._locate_ids = 0
+        self._locate_lock = threading.Lock()
 
     # -- worker plane ------------------------------------------------------
     def _relay_from_worker(self, worker, msg) -> None:
+        if msg and msg[0] == "fetch_object":
+            # P2P pull path; falls back to the head relay on any failure.
+            self.pull_manager.submit(worker, msg)
+            return
         self.conn.send(("from_worker", worker.worker_id.binary(), msg))
+
+    # -- locate RPC to the head -------------------------------------------
+    def locate_object(self, oid_bin: bytes, timeout: float = 30.0):
+        """Ask the head where an object lives: returns ("inline", frame)
+        for memory-store objects or ("shm", node_hex, size, object_addr)
+        (reference: OwnershipBasedObjectDirectory asks the owner)."""
+        waiter = _LocateWaiter()
+        with self._locate_lock:
+            self._locate_ids += 1
+            req_id = self._locate_ids
+            self._locate_pending[req_id] = waiter
+        if not self.conn.send(("locate_object", req_id, oid_bin)):
+            raise ConnectionError("head connection lost")
+        if not waiter.event.wait(timeout):
+            with self._locate_lock:
+                self._locate_pending.pop(req_id, None)
+            raise TimeoutError("locate_object timed out")
+        if not waiter.ok:
+            raise RuntimeError(str(waiter.payload))
+        return waiter.payload
 
     def _on_worker_death(self, worker) -> None:
         self.conn.send(("worker_dead", worker.worker_id.binary()))
 
     # -- main loop ---------------------------------------------------------
     def run(self) -> None:
-        self.conn.send(("register_node", self.node_id.binary(), os.getpid()))
+        info = dict(self.self_register_info or {})
+        info["object_addr"] = self.object_server.address
+        self.conn.send(("register_node", self.node_id.binary(),
+                        os.getpid(), info))
         try:
             while not self._stopped.is_set():
                 msg = self.conn.recv()
@@ -140,6 +188,14 @@ class NodeDaemon:
         elif kind == "store_stats":
             _, req_id = msg
             self.conn.send(("reply", req_id, True, self.store.stats()))
+        elif kind == "locate_reply":
+            _, req_id, ok, payload = msg
+            with self._locate_lock:
+                waiter = self._locate_pending.pop(req_id, None)
+            if waiter is not None:
+                waiter.ok = ok
+                waiter.payload = payload
+                waiter.event.set()
         elif kind == "shutdown":
             self._stopped.set()
 
@@ -149,10 +205,242 @@ class NodeDaemon:
             self.pool.shutdown()
         finally:
             try:
+                self.pull_manager.stop()
+            except Exception:
+                pass
+            try:
+                self.object_server.stop()
+            except Exception:
+                pass
+            try:
                 self.store.destroy()
             except Exception:
                 pass
             self.conn.close()
+
+
+class _LocateWaiter:
+    __slots__ = ("event", "ok", "payload")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.ok = False
+        self.payload = None
+
+
+class ObjectServer:
+    """Serves this daemon's sealed objects to PEER daemons over TCP —
+    chunked pulls, many concurrent requests per connection (reference:
+    ``object_manager.h:114`` ObjectManager push/pull RPC plane; chunks
+    sized by node_protocol.CHUNK_SIZE like the reference's 5MiB)."""
+
+    def __init__(self, store: SharedMemoryStore, host: str = "0.0.0.0"):
+        self._store = store
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, 0))
+        srv.listen(32)
+        self._srv = srv
+        self.address = "%s:%d" % srv.getsockname()[:2]
+        self._stopped = threading.Event()
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="rt-object-server").start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                sock, _ = self._srv.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn,
+                             args=(FrameConn(sock),), daemon=True,
+                             name="rt-object-serve").start()
+
+    def _serve_conn(self, conn: FrameConn) -> None:
+        from .ids import ObjectID
+        from .node_protocol import CHUNK_SIZE
+
+        try:
+            while not self._stopped.is_set():
+                msg = conn.recv()
+                if msg[0] != "pull":
+                    continue
+                _, req_id, oid_bin = msg
+                try:
+                    buf = self._store.get_buffer(ObjectID(oid_bin))
+                except Exception as e:  # noqa: BLE001 — lost/evicted
+                    conn.send(("pull_err", req_id, repr(e)))
+                    continue
+                # Stream straight off the zero-copy store view: only one
+                # CHUNK_SIZE copy is live at a time (no full-object copy).
+                total = max(1, -(-len(buf) // CHUNK_SIZE))
+                ok = True
+                for seq in range(total):
+                    data = bytes(
+                        buf[seq * CHUNK_SIZE:(seq + 1) * CHUNK_SIZE])
+                    if not conn.send(
+                            ("pull_chunk", req_id, seq, total, data)):
+                        ok = False
+                        break
+                if not ok:
+                    return
+        except (EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class PullManager:
+    """Daemon-side cross-node pulls: dedups in-flight pulls per object,
+    prioritizes (get > wait > task-arg prefetch), bounds concurrency,
+    and pulls DIRECTLY from the holder's ObjectServer — the head is a
+    control-plane hop (locate) only, with the old head relay kept as the
+    failure fallback (reference: ``pull_manager.h:47`` chunk scheduling
+    + dedup; ``push_manager.h:29``)."""
+
+    MAX_CONCURRENT = 2
+
+    def __init__(self, daemon: "NodeDaemon"):
+        self._daemon = daemon
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: list = []  # heap of (priority, seq, oid_bin)
+        self._seq = 0
+        # oid -> list[(worker, req_id)] waiting on one in-flight pull
+        self._waiters: Dict[bytes, list] = {}
+        self._inflight: set = set()
+        self._peer_conns: Dict[str, FrameConn] = {}
+        self._stopped = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._loop, daemon=True,
+                             name=f"rt-pull-{i}")
+            for i in range(self.MAX_CONCURRENT)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, worker, msg) -> None:
+        """msg = ("fetch_object", req_id, oid_bin[, priority])."""
+        import heapq
+
+        _, req_id, oid_bin = msg[:3]
+        priority = msg[3] if len(msg) > 3 else 0
+        with self._cv:
+            waiters = self._waiters.setdefault(oid_bin, [])
+            waiters.append((worker, req_id))
+            if oid_bin in self._inflight or len(waiters) > 1:
+                return  # dedup: ride the in-flight pull
+            self._seq += 1
+            heapq.heappush(self._queue, (priority, self._seq, oid_bin))
+            self._cv.notify()
+
+    def _loop(self) -> None:
+        import heapq
+
+        while not self._stopped.is_set():
+            with self._cv:
+                while not self._queue and not self._stopped.is_set():
+                    self._cv.wait(1.0)
+                if self._stopped.is_set():
+                    return
+                _, _, oid_bin = heapq.heappop(self._queue)
+                self._inflight.add(oid_bin)
+            frame = None
+            try:
+                frame = self._pull(oid_bin)
+            except Exception:
+                frame = None
+            with self._cv:
+                waiters = self._waiters.pop(oid_bin, [])
+                self._inflight.discard(oid_bin)
+            for worker, req_id in waiters:
+                if frame is not None:
+                    worker.send(("reply", req_id, True, frame))
+                else:
+                    # Fallback: old head-relay path per waiter.
+                    self._daemon.conn.send(
+                        ("from_worker", worker.worker_id.binary(),
+                         ("fetch_object", req_id, oid_bin)))
+
+    def _pull(self, oid_bin: bytes) -> bytes:
+        from .ids import ObjectID
+
+        # Local store may already hold it (raced with a task result).
+        try:
+            return bytes(self._daemon.store.get_buffer(ObjectID(oid_bin)))
+        except Exception:
+            pass
+        loc = self._daemon.locate_object(oid_bin)
+        if loc[0] == "inline":
+            return loc[1]
+        _, _node_hex, _size, object_addr = loc
+        if not object_addr:
+            raise LookupError("holder has no object server")
+        conn = self._peer_conn(object_addr)
+        try:
+            return self._request_pull(conn, oid_bin)
+        except (EOFError, OSError, ConnectionError):
+            # peer conn went stale (daemon restart): redial once
+            self._drop_peer(object_addr)
+            conn = self._peer_conn(object_addr)
+            return self._request_pull(conn, oid_bin)
+
+    def _request_pull(self, conn: FrameConn, oid_bin: bytes) -> bytes:
+        assembler = ChunkAssembler()
+        with getattr(conn, "_pull_lock"):
+            if not conn.send(("pull", 1, oid_bin)):
+                raise ConnectionError("peer connection lost")
+            while True:
+                msg = conn.recv()
+                if msg[0] == "pull_err":
+                    raise LookupError(msg[2])
+                if msg[0] == "pull_chunk":
+                    _, _req, seq, total, data = msg
+                    full = assembler.add(1, seq, total, data)
+                    if full is not None:
+                        return full
+
+    def _peer_conn(self, addr: str) -> FrameConn:
+        with self._lock:
+            conn = self._peer_conns.get(addr)
+            if conn is not None and not conn.closed:
+                return conn
+        host, port = addr.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)), timeout=15)
+        # Per-recv deadline: a HUNG (not dead) peer must raise so the
+        # redial/head-relay fallback runs instead of wedging a pull
+        # thread forever (socket.timeout is an OSError).
+        sock.settimeout(120)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn = FrameConn(sock)
+        conn._pull_lock = threading.Lock()
+        with self._lock:
+            self._peer_conns[addr] = conn
+        return conn
+
+    def _drop_peer(self, addr: str) -> None:
+        with self._lock:
+            conn = self._peer_conns.pop(addr, None)
+        if conn is not None:
+            conn.close()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._cv:
+            self._cv.notify_all()
+        with self._lock:
+            conns = list(self._peer_conns.values())
+            self._peer_conns.clear()
+        for c in conns:
+            c.close()
 
 
 def main(argv=None) -> int:
@@ -164,15 +452,20 @@ def main(argv=None) -> int:
     parser.add_argument("--num-workers", type=int, default=2)
     parser.add_argument("--env-json", default="{}",
                         help="worker env vars as a JSON object")
+    parser.add_argument("--resources-json", default="",
+                        help="self-register with these resources (shell-"
+                             "started daemons; the head adopts the node)")
     args = parser.parse_args(argv)
 
     import json
 
     env = json.loads(args.env_json)
+    resources = json.loads(args.resources_json) if args.resources_json \
+        else None
     daemon = NodeDaemon(
         NodeID.from_hex(args.node_id), args.driver,
         object_store_memory=args.store_memory or None,
-        env=env, num_workers=args.num_workers,
+        env=env, num_workers=args.num_workers, resources=resources,
     )
     daemon.run()
     return 0

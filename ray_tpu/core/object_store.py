@@ -100,8 +100,73 @@ class SharedMemoryStore:
 
     # -- create/seal ---------------------------------------------------------
     def put_serialized(self, object_id: ObjectID, obj: SerializedObject) -> ObjectMeta:
-        frame = obj.to_bytes()
-        return self.put_bytes(object_id, frame)
+        """Zero-copy put: write the frame (header + inband + out-of-band
+        buffers) straight into the arena extent — no intermediate flat
+        bytes object (reference: plasma Create/Seal + pickle5 out-of-band
+        path in ``python/ray/_private/serialization.py``)."""
+        size = obj.frame_bytes()
+        with self._lock:
+            if object_id in self._meta:
+                return self._meta[object_id]
+            self._ensure_capacity(size)
+            backend = "segment"
+            if self._arena is not None:
+                self._arena_create_write_seal(object_id, obj, size)
+                backend = "arena"
+            else:
+                seg = shared_memory.SharedMemory(
+                    create=True, size=max(size, 1),
+                    name=_segment_name(object_id)
+                )
+                obj.write_into(memoryview(seg.buf)[:size])
+                self._segments[object_id] = seg
+            meta = ObjectMeta(object_id, size, self.node_id, backend=backend)
+            self._meta[object_id] = meta
+            self.used += size
+            return meta
+
+    def _arena_create_write_seal(self, object_id: ObjectID,
+                                 obj: SerializedObject, size: int) -> None:
+        """create_object → write_into → seal, spilling + retrying on a
+        full arena exactly like the copying path."""
+        from .._native import NativeStoreFull, NativeStoreUnsealed
+
+        key = object_id.binary()
+
+        def attempt() -> bool:
+            try:
+                try:
+                    view = self._arena.create_object(key, size)
+                except NativeStoreUnsealed:
+                    # A prior writer died between create and seal; the
+                    # owner serializes same-key writes, so reclaim it.
+                    self._arena.abort(key)
+                    view = self._arena.create_object(key, size)
+            except NativeStoreFull:
+                return False
+            try:
+                obj.write_into(view)
+            except BaseException:
+                self._arena.abort(key)
+                raise
+            finally:
+                view.release()
+            self._arena.seal(key)
+            return True
+
+        if attempt():
+            return
+        for meta in sorted(
+                (m for m in self._meta.values()
+                 if m.pinned == 0 and m.spilled_path is None
+                 and m.backend == "arena" and m.object_id != object_id),
+                key=lambda m: m.last_access):
+            self._spill(meta)
+            if attempt():
+                return
+        raise ObjectStoreFullError(
+            f"arena full putting {size} bytes "
+            f"(used {self._used_now()}/{self.capacity})")
 
     def put_bytes(self, object_id: ObjectID, frame: bytes) -> ObjectMeta:
         size = len(frame)
@@ -175,6 +240,33 @@ class SharedMemoryStore:
             seg = self._segments[object_id]
             return memoryview(seg.buf)[: meta.size]
 
+    def get_pinned(self, object_id: ObjectID) -> memoryview:
+        """Zero-copy read for value materialization: a read-only view
+        whose arena pin is released when the last derived view (numpy
+        arrays deserialized out of band) is garbage-collected. Values
+        may safely outlive the object's deletion — deferred-free keeps
+        the extent until the last pin drops (plasma client semantics).
+        Falls back to spill-file bytes / segment views where pinning
+        does not apply."""
+        with self._lock:
+            meta = self._meta.get(object_id)
+            if meta is None:
+                raise ObjectLostError(object_id)
+            meta.last_access = time.monotonic()
+            if meta.spilled_path is not None:
+                frame = self._restore(meta)
+                if frame is not None:
+                    return memoryview(frame)
+            if meta.backend == "arena" and self._arena is not None:
+                view = self._arena.get_pinned(object_id.binary())
+                if view is None:
+                    raise ObjectLostError(object_id)
+                return view
+            seg = self._segments[object_id]
+            # read-only: sealed objects are immutable; a writable view
+            # would let deserialized numpy values mutate the store.
+            return memoryview(seg.buf).toreadonly()[: meta.size]
+
     def meta(self, object_id: ObjectID) -> Optional[ObjectMeta]:
         with self._lock:
             return self._meta.get(object_id)
@@ -207,6 +299,13 @@ class SharedMemoryStore:
                         seg.unlink()
                     except FileNotFoundError:
                         pass
+                    except BufferError:
+                        # A zero-copy view is still exported; unlink the
+                        # name but keep the mapping alive for the reader.
+                        try:
+                            seg.unlink()
+                        except FileNotFoundError:
+                            pass
                     self.used -= meta.size
             if meta.spilled_path and os.path.exists(meta.spilled_path):
                 os.unlink(meta.spilled_path)
@@ -388,6 +487,44 @@ class ShmClient:
             self._attached[_segment_name(object_id)] = seg
         return len(frame)
 
+    def create_and_seal_serialized(self, object_id: ObjectID,
+                                   obj: SerializedObject) -> int:
+        """Zero-copy seal: write header/inband/out-of-band buffers straight
+        into the arena extent (plasma Create/Seal), no flat intermediate."""
+        from .._native import NativeStoreExists, NativeStoreUnsealed
+
+        size = obj.frame_bytes()
+        if self._arena is not None:
+            key = object_id.binary()
+            try:
+                try:
+                    view = self._arena.create_object(key, size)
+                except NativeStoreUnsealed:
+                    # Prior writer died mid-create; reclaim and retry.
+                    self._arena.abort(key)
+                    view = self._arena.create_object(key, size)
+            except NativeStoreExists:
+                return size  # idempotent re-put
+            except Exception:
+                view = None  # full/unavailable: fall back below
+            if view is not None:
+                try:
+                    obj.write_into(view)
+                except BaseException:
+                    self._arena.abort(key)
+                    raise
+                finally:
+                    view.release()
+                self._arena.seal(key)
+                return size
+        seg = shared_memory.SharedMemory(
+            create=True, size=max(size, 1), name=_segment_name(object_id)
+        )
+        obj.write_into(memoryview(seg.buf)[:size])
+        with self._lock:
+            self._attached[_segment_name(object_id)] = seg
+        return size
+
     def _arena_for(self, node_hex: Optional[str]):
         if self._native is None:
             return None
@@ -415,10 +552,11 @@ class ShmClient:
                 f"arena {node_hex[:8]} is on another host")
         for arena in (self._arena_for(node_hex), self._arena):
             if arena is not None:
-                view = arena.get(object_id.binary())
+                view = arena.get_pinned(object_id.binary())
                 if view is not None:
-                    # Pin stays for the worker's lifetime: zero-copy views
-                    # may back live numpy arrays in user code.
+                    # Pin released when the last derived view (numpy in
+                    # user code) is collected; deferred-free protects the
+                    # extent meanwhile.
                     return view
         name = _segment_name(object_id)
         with self._lock:

@@ -357,7 +357,8 @@ class RemoteNode:
                  driver_addr: str, accept_conn: Callable,
                  object_store_memory: Optional[int] = None,
                  env: Optional[dict] = None, labels: Optional[dict] = None,
-                 on_change: Optional[Callable[[], None]] = None):
+                 on_change: Optional[Callable[[], None]] = None,
+                 on_locate: Optional[Callable] = None):
         from .config import config
 
         self.node_id = node_id
@@ -369,6 +370,7 @@ class RemoteNode:
         self._on_worker_death = on_worker_death
         self._on_node_death = on_node_death
         self._on_change = on_change or (lambda: None)
+        self._on_locate = on_locate
 
         num_workers = config().num_workers_per_node or max(
             2, int(resources.get("CPU", 2)))
@@ -387,16 +389,54 @@ class RemoteNode:
              "--env-json", env_json],
             cwd=repo_root, env=proc_env,
         )
-        raw_conn = accept_conn(node_id)  # blocks until daemon registers
+        raw_conn, reg_info = accept_conn(node_id)  # blocks until registered
+        self.object_addr = (reg_info or {}).get("object_addr")
         self.conn = DaemonConn(raw_conn, self._on_event, self._disconnected)
         self.pool = RemoteWorkerPool(node_id, num_workers, self.conn,
                                      self._on_change)
         self.store = RemoteStoreClient(self.conn)
         self._down = False
 
+    @classmethod
+    def adopt(cls, node_id: NodeID, resources: Dict[str, float],
+              message_handler: Callable, on_worker_death: Callable,
+              on_node_death: Callable, raw_conn, num_workers: int,
+              labels: Optional[dict] = None,
+              on_change: Optional[Callable[[], None]] = None,
+              object_addr: Optional[str] = None,
+              on_locate: Optional[Callable] = None) -> "RemoteNode":
+        """Attach to a daemon that STARTED ITSELF (``rt start
+        --address=...``) and registered over the cluster listener — no
+        process spawn; the daemon's lifetime belongs to its own shell/
+        systemd (reference: raylets started by ``ray start`` joining the
+        GCS, scripts.py:532)."""
+        self = cls.__new__(cls)
+        self.node_id = node_id
+        self.ledger = ResourceLedger(dict(resources))
+        self.labels = labels or {}
+        self.pg_bundles = {}
+        self.alive = True
+        self._message_handler = message_handler
+        self._on_worker_death = on_worker_death
+        self._on_node_death = on_node_death
+        self._on_change = on_change or (lambda: None)
+        self._on_locate = on_locate
+        self.object_addr = object_addr
+        self.process = None
+        self.conn = DaemonConn(raw_conn, self._on_event, self._disconnected)
+        self.pool = RemoteWorkerPool(node_id, num_workers, self.conn,
+                                     self._on_change)
+        self.store = RemoteStoreClient(self.conn)
+        self._down = False
+        return self
+
     # -- daemon events -----------------------------------------------------
     def _on_event(self, msg: tuple) -> None:
         kind = msg[0]
+        if kind == "locate_object":
+            if self._on_locate is not None:
+                self._on_locate(self, msg[1], msg[2])
+            return
         if kind == "worker_started":
             self.pool._on_worker_started(msg[1], msg[2] if len(msg) > 2
                                          else 0)
@@ -440,6 +480,8 @@ class RemoteNode:
         except Exception:
             pass
         self.conn.close()
+        if self.process is None:
+            return  # adopted daemon: its own shell owns the process
         try:
             self.process.terminate()
             self.process.wait(timeout=3)

@@ -230,6 +230,7 @@ class Runtime:
                 object_store_memory=object_store_memory,
                 env=self._env, labels=labels,
                 on_change=self.scheduler.notify,
+                on_locate=self._handle_daemon_locate,
             )
         else:
             node = NodeManager(
@@ -245,7 +246,8 @@ class Runtime:
         return node_id
 
     # -- node-daemon attach plane (reference: raylet -> GCS registration) --
-    def _ensure_cluster_listener(self) -> None:
+    def _ensure_cluster_listener(self, host: Optional[str] = None,
+                                 port: Optional[int] = None) -> None:
         if getattr(self, "_cluster_listener", None) is not None:
             return
         import socket as socket_mod
@@ -255,10 +257,10 @@ class Runtime:
         srv = socket_mod.socket(socket_mod.AF_INET,
                                 socket_mod.SOCK_STREAM)
         srv.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1)
-        srv.bind(("127.0.0.1", 0))
+        srv.bind((host or "127.0.0.1", port or 0))
         srv.listen(64)
         self._cluster_listener = srv
-        self._cluster_addr = "127.0.0.1:%d" % srv.getsockname()[1]
+        self._cluster_addr = "%s:%d" % srv.getsockname()[:2]
         self._daemon_conns: Dict[bytes, object] = {}
         self._daemon_cv = threading.Condition()
 
@@ -279,12 +281,41 @@ class Runtime:
                 if msg[0] != "register_node":
                     conn.close()
                     continue
+                info = msg[3] if len(msg) > 3 and isinstance(msg[3], dict) \
+                    else {}
+                if info.get("self_register"):
+                    # Shell-started daemon (``rt start --address=...``):
+                    # adopt it as a cluster node.
+                    try:
+                        self._adopt_daemon(NodeID(msg[1]), conn, info)
+                    except Exception:
+                        conn.close()
+                    continue
                 with self._daemon_cv:
-                    self._daemon_conns[msg[1]] = conn
+                    self._daemon_conns[msg[1]] = (conn, info)
                     self._daemon_cv.notify_all()
 
         threading.Thread(target=accept_loop, daemon=True,
                          name="rt-cluster-accept").start()
+
+    def _adopt_daemon(self, node_id: NodeID, conn, info: dict) -> None:
+        """Adopt a self-registered daemon into the cluster (reference:
+        GCS node registration from ``ray start --address=...`` raylets)."""
+        from .remote_node import RemoteNode
+
+        resources = dict(info.get("resources") or {"CPU": 1.0})
+        node = RemoteNode.adopt(
+            node_id, resources, self._handle_worker_message,
+            self._handle_worker_death, self._on_daemon_node_death,
+            conn, int(info.get("num_workers") or 2),
+            labels=info.get("labels"), on_change=self.scheduler.notify,
+            object_addr=info.get("object_addr"),
+            on_locate=self._handle_daemon_locate,
+        )
+        node.start()
+        self.scheduler.add_node(node, topology=info.get("topology"))
+        if hasattr(self, "placement_group_manager"):
+            self.placement_group_manager.retry_pending()
 
     def _accept_daemon_conn(self, node_id: NodeID, timeout: float = 30.0):
         deadline = time.monotonic() + timeout
@@ -296,6 +327,87 @@ class Runtime:
                         f"node daemon {node_id.hex()[:8]} did not register")
                 self._daemon_cv.wait(remaining)
             return self._daemon_conns.pop(node_id.binary())
+
+    def _fetch_frame_blocking(self, oid: ObjectID,
+                              timeout: float = 120.0) -> bytes:
+        """Serve an object's raw frame, riding out loss: a LOST object
+        (holder daemon died mid-pull) triggers lineage reconstruction
+        (``_recover_object``) and the wait resumes until the recomputed
+        copy seals (reference: ObjectRecoveryManager + PullManager
+        retry)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                entry = self._objects.get(oid)
+                status = entry.status if entry is not None else None
+                location = entry.location if entry is not None else None
+                error = entry.error if entry is not None else None
+            if entry is None:
+                raise ObjectLostError(oid, "unknown object")
+            if status == _ObjStatus.FAILED:
+                raise error
+            if status == _ObjStatus.READY and location is not None:
+                try:
+                    if location[0] == "memory":
+                        frame = self.memory_store.get(oid)
+                        if frame is None:
+                            raise ObjectLostError(oid)
+                        return frame
+                    _, node_id, _size = location
+                    node = self.scheduler.get_node(node_id)
+                    if node is None:
+                        raise ObjectLostError(oid, "holding node gone")
+                    return bytes(node.store.get_buffer(oid))
+                except ObjectLostError:
+                    with self._lock:
+                        entry.status = _ObjStatus.LOST
+                        entry.location = None
+            with self._lock:
+                lost = entry.status == _ObjStatus.LOST
+            if lost:
+                self._recover_object(oid)
+            ev = threading.Event()
+            self.add_ready_watcher(oid, ev.set)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not ev.wait(min(remaining, 10.0)):
+                if time.monotonic() >= deadline:
+                    raise GetTimeoutError(
+                        f"fetch of {oid.hex()[:8]} timed out")
+
+    def _handle_daemon_locate(self, node, req_id: int,
+                              oid_bin: bytes) -> None:
+        """Answer a daemon's P2P locate: ("inline", frame) for memory-
+        store objects, else ("shm", holder_hex, size, object_addr) so the
+        daemon pulls straight from the holder's ObjectServer (reference:
+        OwnershipBasedObjectDirectory — the owner answers locations)."""
+        try:
+            oid = ObjectID(oid_bin)
+            with self._lock:
+                entry = self._objects.get(oid)
+                location = entry.location if entry is not None else None
+            if location is None:
+                raise ObjectLostError(oid, "no known location")
+            if location[0] == "memory":
+                payload = ("inline", self.memory_store.get(oid))
+            else:
+                _, holder_id, size = location
+                holder = self.scheduler.get_node(holder_id)
+                if holder is None:
+                    raise ObjectLostError(oid, "holding node is gone")
+                addr = getattr(holder, "object_addr", None)
+                if addr is None:
+                    # Holder is the head-local NodeManager (no object
+                    # server): ship the frame inline.
+                    payload = ("inline",
+                               bytes(holder.store.get_buffer(oid)))
+                else:
+                    payload = ("shm", holder_id.hex(), size, addr)
+            node.conn.send(("locate_reply", req_id, True, payload))
+        except Exception as e:  # noqa: BLE001
+            try:
+                node.conn.send(("locate_reply", req_id, False, repr(e)))
+            except Exception:
+                pass
 
     def _on_daemon_node_death(self, node_id: NodeID) -> None:
         """Connection to a daemon dropped => the host is gone (chaos or
@@ -373,8 +485,18 @@ class Runtime:
             self._put_counter += 1
             oid = ObjectID.for_put(self.driver_task_id, self._put_counter)
         serialized = self.serializer.serialize(value)
-        frame = serialized.to_bytes()
-        self._store_frame(oid, frame)
+        size = serialized.frame_bytes()
+        if size <= config().max_direct_call_object_size:
+            self._store_frame(oid, serialized.to_bytes())
+        else:
+            # Zero-copy: out-of-band buffers memcpy straight into the
+            # shm arena extent, no intermediate flat bytes object.
+            node = self.scheduler.nodes()[0]
+            if hasattr(node.store, "put_serialized"):
+                node.store.put_serialized(oid, serialized)
+            else:  # daemon-backed store: chunked network push
+                node.store.put_bytes(oid, serialized.to_bytes())
+            self._mark_ready(oid, ("shm", node.node_id, size))
         return ObjectRef(oid)
 
     def _store_frame(self, oid: ObjectID, frame: bytes,
@@ -502,9 +624,13 @@ class Runtime:
         node = self.scheduler.get_node(node_id)
         if node is None:
             raise ObjectLostError(oid, f"node {node_id.hex()[:8]} holding object is gone")
-        buf = node.store.get_buffer(oid)
-        # Copy out of shm on the driver: values outlive store eviction.
-        return self.serializer.deserialize(bytes(buf))
+        if hasattr(node.store, "get_pinned"):
+            # Zero-copy: numpy values deserialize as read-only views into
+            # the arena; the pin (released on GC) + deferred-free let them
+            # safely outlive store eviction.
+            return self.serializer.deserialize(node.store.get_pinned(oid))
+        # Daemon-backed store: the network pull is already a private copy.
+        return self.serializer.deserialize(node.store.get_buffer(oid))
 
     def _object_entry_payload(self, oid: ObjectID):
         """Entry for shipping to a worker: inline frame or shm pointer."""
@@ -678,9 +804,20 @@ class Runtime:
         node, worker, spec = record.node, record.worker, record.spec
         if node is not None and worker is not None:
             if spec.task_type != TaskType.ACTOR_TASK:
-                node.pool.return_worker(worker)
-                if not record.resources_released:
-                    self.scheduler.release(node, spec)
+                if record.resources_released:
+                    node.pool.return_worker(worker)
+                    return
+                # Worker-reuse fast path (OnWorkerIdle): dispatch the next
+                # compatible queued task to this worker directly from the
+                # completion handler, skipping a scheduler-thread wake.
+                lease = self.scheduler.reuse_or_return(node, worker, spec)
+                if lease is not None:
+                    try:
+                        lease.on_granted(node, worker)
+                    except Exception as e:  # pragma: no cover — defensive
+                        self.scheduler.release(node, lease.spec)
+                        node.pool.return_worker(worker)
+                        lease.on_unschedulable(str(e))
 
     def _decrement_arg_pins(self, spec: TaskSpec) -> None:
         for oid in list(spec.arg_refs) + list(spec.borrowed_refs):
@@ -1242,24 +1379,15 @@ class Runtime:
         kind, req_id = msg[0], msg[1]
         try:
             if kind == "fetch_object":
-                # Cross-host object pull: return the raw frame, fetched
-                # from the owning node's store (for daemon-backed nodes
-                # this is the chunked TCP transfer).
-                _, _, oid_bin = msg
-                oid = ObjectID(oid_bin)
-                with self._lock:
-                    entry = self._objects.get(oid)
-                    location = entry.location if entry is not None else None
-                if location is None:
-                    raise ObjectLostError(oid, "no known location")
-                if location[0] == "memory":
-                    frame = self.memory_store.get(oid)
-                else:
-                    _, node_id, _size = location
-                    node = self.scheduler.get_node(node_id)
-                    if node is None:
-                        raise ObjectLostError(oid, "holding node is gone")
-                    frame = bytes(node.store.get_buffer(oid))
+                # Cross-host object pull FALLBACK: daemons normally pull
+                # peer-to-peer (PullManager); reaching this head relay
+                # means P2P failed (or the worker is head-local). Counted
+                # so tests can assert the relay stays cold. Blocks (on
+                # the bounded fetch pool) through lineage reconstruction
+                # when the holder died mid-pull.
+                self.relay_fetch_count = getattr(
+                    self, "relay_fetch_count", 0) + 1
+                frame = self._fetch_frame_blocking(ObjectID(msg[2]))
                 worker.send(("reply", req_id, True, frame))
             elif kind == "put":
                 _, _, oid_bin, entry = msg

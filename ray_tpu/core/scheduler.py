@@ -139,6 +139,28 @@ class PendingLease:
     on_granted: Callable[["NodeManager", WorkerHandle], None]
     on_unschedulable: Callable[[str], None]
     deps_ready: bool = False
+    _sched_key: Optional[tuple] = None
+
+    @property
+    def scheduling_key(self) -> tuple:
+        """Tasks with equal keys are interchangeable for placement
+        (reference: SchedulingKey in ``direct_task_transport.h`` — lease
+        requests are pooled per key). Used to (a) skip whole key classes
+        once one lease of the class can't place in a scheduler pass and
+        (b) reuse idle workers for same-key tasks."""
+        if self._sched_key is None:
+            s = self.spec
+            strat = s.strategy
+            self._sched_key = (
+                s.task_type.value,
+                tuple(sorted(s.resources.items())),
+                strat.kind,
+                strat.node_id,
+                strat.placement_group_id.binary()
+                if strat.placement_group_id is not None else None,
+                strat.bundle_index,
+            )
+        return self._sched_key
 
 
 class ClusterScheduler:
@@ -263,12 +285,23 @@ class ClusterScheduler:
                 if self._stopped:
                     return
                 remaining: List[PendingLease] = []
+                # Once one lease of a scheduling key fails to place in this
+                # pass, every later same-key lease would fail identically —
+                # skip them so a deep homogeneous queue costs O(n) per pass
+                # instead of O(n) placement attempts (the old full rescan
+                # made batched async submission quadratic).
+                blocked_keys = set()
                 for lease in self._queue:
                     if not lease.deps_ready:
                         remaining.append(lease)
                         continue
+                    key = lease.scheduling_key
+                    if key in blocked_keys:
+                        remaining.append(lease)
+                        continue
                     node = self._pick_node(lease.spec)
                     if node is None:
+                        blocked_keys.add(key)
                         if self._feasible_somewhere(lease.spec):
                             remaining.append(lease)
                         else:
@@ -287,6 +320,7 @@ class ClusterScheduler:
                         worker = node.pool.try_pop_idle()
                         if worker is None:
                             remaining.append(lease)
+                            blocked_keys.add(key)
                             continue
                     if lease.spec.strategy.kind != "PLACEMENT_GROUP":
                         node.ledger.acquire(lease.spec.resources)
@@ -298,6 +332,8 @@ class ClusterScheduler:
                 try:
                     lease.on_granted(node, worker)
                 except Exception as e:  # pragma: no cover — defensive
+                    self.release(node, lease.spec)
+                    node.pool.return_worker(worker)
                     lease.on_unschedulable(str(e))
 
     def _recheck_infeasible_locked(self) -> None:
@@ -314,6 +350,40 @@ class ClusterScheduler:
             if spec.strategy.kind != "PLACEMENT_GROUP":
                 node.ledger.release(spec.resources)
             self._wake.notify_all()
+
+    def reuse_or_return(self, node: NodeManager, worker: WorkerHandle,
+                        finished_spec: TaskSpec) -> Optional[PendingLease]:
+        """Completion fast path (reference: ``OnWorkerIdle``,
+        ``direct_task_transport.h:135``): release the finished task's
+        resources and hand the still-leased worker the next compatible
+        queued lease directly, skipping the scheduler-thread round trip.
+        Returns the claimed lease (caller dispatches it on its own
+        thread) or None after returning the worker to the pool.
+
+        Only DEFAULT-strategy normal tasks are reused: SPREAD must
+        rotate nodes, PG/affinity tasks carry placement constraints, and
+        actor creation needs a dedicated worker.
+        """
+        with self._lock:
+            if finished_spec.strategy.kind != "PLACEMENT_GROUP":
+                node.ledger.release(finished_spec.resources)
+            reusable = (node.alive and worker.alive()
+                        and worker.state == WorkerHandle.LEASED)
+            if reusable:
+                for i, lease in enumerate(self._queue):
+                    spec = lease.spec
+                    if (not lease.deps_ready
+                            or spec.task_type != TaskType.NORMAL_TASK
+                            or spec.strategy.kind != "DEFAULT"):
+                        continue
+                    if not node.ledger.fits(spec.resources):
+                        continue
+                    node.ledger.acquire(spec.resources)
+                    del self._queue[i]
+                    return lease
+            node.pool.return_worker(worker)
+            self._wake.notify_all()
+            return None
 
     def shutdown(self) -> None:
         with self._lock:

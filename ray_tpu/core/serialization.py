@@ -48,17 +48,33 @@ class SerializedObject:
     def total_bytes(self) -> int:
         return len(self.inband) + sum(b.raw().nbytes for b in self.buffers)
 
+    def frame_bytes(self) -> int:
+        """Exact size of the flattened frame (header + inband + buffers)."""
+        return (4 + 8 * (1 + len(self.buffers)) + len(self.inband)
+                + sum(b.raw().nbytes for b in self.buffers))
+
+    def write_into(self, view: memoryview) -> None:
+        """Write the flattened frame directly into a writable buffer —
+        the zero-copy put path: each out-of-band buffer memcpys straight
+        into the (typically shm-arena-backed) destination with no
+        intermediate bytes object."""
+        header = [len(self.inband)] + [b.raw().nbytes for b in self.buffers]
+        off = 4 + 8 * len(header)
+        view[:4] = len(header).to_bytes(4, "little")
+        for i, h in enumerate(header):
+            view[4 + 8 * i: 12 + 8 * i] = h.to_bytes(8, "little")
+        view[off: off + len(self.inband)] = self.inband
+        off += len(self.inband)
+        for b in self.buffers:
+            raw = b.raw()  # flat contiguous uint8 view per PickleBuffer.raw
+            view[off: off + raw.nbytes] = raw
+            off += raw.nbytes
+
     def to_bytes(self) -> bytes:
         """Flatten to one contiguous frame: [n][len(inband)][inband][bufs...]."""
-        out = io.BytesIO()
-        header = [len(self.inband)] + [b.raw().nbytes for b in self.buffers]
-        out.write(len(header).to_bytes(4, "little"))
-        for h in header:
-            out.write(h.to_bytes(8, "little"))
-        out.write(self.inband)
-        for b in self.buffers:
-            out.write(b.raw())
-        return out.getvalue()
+        out = bytearray(self.frame_bytes())
+        self.write_into(memoryview(out))
+        return bytes(out)
 
 
 def _split_frames(data: memoryview) -> Tuple[memoryview, List[memoryview]]:
@@ -76,6 +92,57 @@ def _split_frames(data: memoryview) -> Tuple[memoryview, List[memoryview]]:
     return inband, buffers
 
 
+class _RTPickler(cloudpickle.CloudPickler):
+    """CloudPickler intercepting ObjectRefs (borrow tracking) and
+    jax.Arrays (host transfer + sharding metadata). Defined once at module
+    level — per-call class creation dominated small-put latency."""
+
+    def __init__(self, file, serializer: "Serializer", buffers, contained,
+                 buffer_callback):
+        super().__init__(file, protocol=_PROTOCOL,
+                         buffer_callback=buffer_callback)
+        self._rt_serializer = serializer
+        self._rt_contained = contained
+
+    def persistent_id(self, obj):
+        return None
+
+    def reducer_override(self, obj):
+        ref_class = self._rt_serializer._ref_class
+        if ref_class is not None and isinstance(obj, ref_class):
+            self._rt_contained.append(obj)
+            return (ref_class._deserialize, (obj.id, obj.owner,))
+        try:
+            import jax
+
+            if isinstance(obj, jax.Array):
+                import numpy as np
+
+                spec = None
+                try:
+                    sh = obj.sharding
+                    if hasattr(sh, "spec"):
+                        spec = (
+                            tuple(sh.mesh.axis_names),
+                            tuple(
+                                tuple(p) if isinstance(p, (list, tuple)) else p
+                                for p in tuple(sh.spec)
+                            ),
+                        )
+                except Exception:
+                    spec = None
+                host = np.asarray(jax.device_get(obj))
+                return (
+                    _rebuild_device_array,
+                    (DeviceArrayPayload(host, spec),),
+                )
+        except ImportError:
+            pass
+        # Delegate to CloudPickler so local functions/classes keep
+        # their by-value reduction.
+        return super().reducer_override(obj)
+
+
 class Serializer:
     """Pickles values; intercepts ObjectRefs (borrow tracking) and jax.Arrays."""
 
@@ -91,46 +158,8 @@ class Serializer:
             buffers.append(buf)
             return False  # out-of-band
 
-        class _Pickler(cloudpickle.CloudPickler):
-            def persistent_id(self_inner, obj):  # noqa: N805
-                return None
-
-            def reducer_override(self_inner, obj):  # noqa: N805
-                if self._ref_class is not None and isinstance(obj, self._ref_class):
-                    contained.append(obj)
-                    return (self._ref_class._deserialize, (obj.id, obj.owner,))
-                try:
-                    import jax
-
-                    if isinstance(obj, jax.Array):
-                        import numpy as np
-
-                        spec = None
-                        try:
-                            sh = obj.sharding
-                            if hasattr(sh, "spec"):
-                                spec = (
-                                    tuple(sh.mesh.axis_names),
-                                    tuple(
-                                        tuple(p) if isinstance(p, (list, tuple)) else p
-                                        for p in tuple(sh.spec)
-                                    ),
-                                )
-                        except Exception:
-                            spec = None
-                        host = np.asarray(jax.device_get(obj))
-                        return (
-                            _rebuild_device_array,
-                            (DeviceArrayPayload(host, spec),),
-                        )
-                except ImportError:
-                    pass
-                # Delegate to CloudPickler so local functions/classes keep
-                # their by-value reduction.
-                return super().reducer_override(obj)
-
         f = io.BytesIO()
-        p = _Pickler(f, protocol=_PROTOCOL, buffer_callback=buffer_callback)
+        p = _RTPickler(f, self, buffers, contained, buffer_callback)
         p.dump(value)
         return SerializedObject(f.getvalue(), buffers, contained)
 

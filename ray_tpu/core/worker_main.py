@@ -72,6 +72,12 @@ class WorkerRuntime:
         self._shutdown = threading.Event()
         self.current_task_id: Optional[TaskID] = None
         self._put_counter = 0
+        self._out_q: list = []
+        self._out_cond = threading.Condition()
+        self._sending = False
+        self._sender_thread = threading.Thread(
+            target=self._sender_loop, daemon=True, name="rt-worker-sender")
+        self._sender_thread.start()
         # Borrower protocol (reference_count.h borrower reports): every ref
         # held in this worker pins the object at the owner; GC of the local
         # ref releases the pin via a fire-and-forget message.
@@ -93,8 +99,44 @@ class WorkerRuntime:
 
     # -- transport -----------------------------------------------------------
     def _send(self, msg) -> None:
-        with self._send_lock:
-            self.conn.send(msg)
+        """Enqueue for the sender thread, which coalesces bursts (e.g. a
+        run of task-done replies) into one pipe frame."""
+        with self._out_cond:
+            self._out_q.append(msg)
+            self._out_cond.notify()
+
+    def _sender_loop(self) -> None:
+        while True:
+            with self._out_cond:
+                self._sending = False
+                self._out_cond.notify_all()  # wake flush_outbound
+                while not self._out_q and not self._shutdown.is_set():
+                    self._out_cond.wait()
+                if self._shutdown.is_set() and not self._out_q:
+                    return
+                msgs, self._out_q = self._out_q, []
+                self._sending = True
+            try:
+                with self._send_lock:
+                    self.conn.send(
+                        msgs[0] if len(msgs) == 1 else ("batch", msgs))
+            except (BrokenPipeError, OSError):
+                # The pipe to the owner is gone: a mute-but-alive worker
+                # would hang its callers forever — die loudly so the
+                # owner's death path fails/retries our tasks.
+                os._exit(1)
+
+    def flush_outbound(self, timeout: float = 5.0) -> None:
+        """Block until every queued outbound message hit the pipe (or
+        timeout). Called on worker exit so final replies aren't lost."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        with self._out_cond:
+            self._out_cond.notify_all()
+            while ((self._out_q or self._sending)
+                   and _time.monotonic() < deadline):
+                self._out_cond.wait(0.05)
 
     def _rpc(self, kind: str, *payload) -> Any:
         with self._rpc_lock:
@@ -108,26 +150,28 @@ class WorkerRuntime:
     def _reader_loop(self) -> None:
         try:
             while not self._shutdown.is_set():
-                msg = self.conn.recv()
-                kind = msg[0]
-                if kind == "exec":
-                    self._task_queue.put(msg)
-                elif kind == "reply":
-                    _, req_id, ok, value = msg
-                    with self._rpc_lock:
-                        fut = self._pending_rpcs.pop(req_id, None)
-                    if fut is not None:
-                        if ok:
-                            fut.set_result(value)
-                        else:
-                            fut.set_exception(value)
-                elif kind == "exit":
-                    self._shutdown.set()
-                    self._task_queue.put(None)
-                elif kind == "drain_exit":
-                    # Graceful: already-queued tasks run first, then the
-                    # loop stops (reference: __ray_terminate__ semantics).
-                    self._task_queue.put(None)
+                frame = self.conn.recv()
+                msgs = frame[1] if frame[0] == "batch" else (frame,)
+                for msg in msgs:
+                    kind = msg[0]
+                    if kind == "exec":
+                        self._task_queue.put(msg)
+                    elif kind == "reply":
+                        _, req_id, ok, value = msg
+                        with self._rpc_lock:
+                            fut = self._pending_rpcs.pop(req_id, None)
+                        if fut is not None:
+                            if ok:
+                                fut.set_result(value)
+                            else:
+                                fut.set_exception(value)
+                    elif kind == "exit":
+                        self._shutdown.set()
+                        self._task_queue.put(None)
+                    elif kind == "drain_exit":
+                        # Graceful: already-queued tasks run first, then
+                        # the loop stops (reference: __ray_terminate__).
+                        self._task_queue.put(None)
         except (EOFError, OSError):
             self._shutdown.set()
             self._task_queue.put(None)
@@ -146,16 +190,18 @@ class WorkerRuntime:
 
     def put(self, value):
         serialized = self.serializer.serialize(value)
-        frame = serialized.to_bytes()
+        size = serialized.frame_bytes()
         self._put_counter += 1
         inline_limit = int(os.environ.get(_INLINE_LIMIT_ENV, 100 * 1024))
         task_id = self.current_task_id or TaskID.nil()
         object_id = ObjectID.for_put(task_id, self._put_counter)
-        if len(frame) <= inline_limit:
-            oid_bin = self._rpc("put", object_id.binary(), ("inline", frame))
+        if size <= inline_limit:
+            oid_bin = self._rpc("put", object_id.binary(),
+                                ("inline", serialized.to_bytes()))
         else:
-            self.shm.create_and_seal(object_id, frame)
-            oid_bin = self._rpc("put", object_id.binary(), ("shm", len(frame)))
+            # Zero-copy: buffers memcpy straight into the shm arena.
+            self.shm.create_and_seal_serialized(object_id, serialized)
+            oid_bin = self._rpc("put", object_id.binary(), ("shm", size))
         ref = ObjectRef(ObjectID(oid_bin), _register=False)
         ref._counted = True  # head's put handler took the +1
         return ref
@@ -192,7 +238,10 @@ class WorkerRuntime:
     def cancel(self, object_id_bin: bytes, force: bool):
         return self._rpc("cancel", object_id_bin, force)
 
-    def _materialize(self, entry):
+    def _materialize(self, entry, priority: int = 0):
+        """priority: 0 = blocking get, 2 = task-arg prefetch — consumed
+        by the daemon's PullManager (get > wait > task-args ordering,
+        reference: ``pull_manager.h:47``)."""
         kind, payload = entry
         if kind == "inline":
             return self.serializer.deserialize(payload)
@@ -202,12 +251,13 @@ class WorkerRuntime:
             try:
                 view = self.shm.read(ObjectID(oid_bin), size, node_hex)
             except Exception:
-                # Object lives on another HOST (arena not attachable):
-                # pull the bytes through the head, which fetches from the
-                # owning node daemon over its connection (the chunked DCN
-                # transfer path; reference: PullManager -> remote
+                # Object lives on another HOST (arena not attachable).
+                # Daemon-backed workers: the daemon intercepts this RPC
+                # and pulls PEER-TO-PEER from the holder's ObjectServer
+                # (node_daemon.PullManager); the head relay is only the
+                # fallback (reference: PullManager -> remote
                 # ObjectManager push).
-                frame = self._rpc("fetch_object", oid_bin)
+                frame = self._rpc("fetch_object", oid_bin, priority)
                 return self.serializer.deserialize(frame)
             return self.serializer.deserialize(view)
         if kind == "error":
@@ -242,13 +292,15 @@ class WorkerRuntime:
         out = []
         task_id = TaskID.from_hex(task_id_hex)
         for i, v in enumerate(values):
-            frame = self.serializer.serialize(v).to_bytes()
+            serialized = self.serializer.serialize(v)
+            size = serialized.frame_bytes()
             oid = ObjectID.for_return(task_id, i)
-            if len(frame) <= inline_limit:
-                out.append(("inline", frame))
+            if size <= inline_limit:
+                out.append(("inline", serialized.to_bytes()))
             else:
-                self.shm.create_and_seal(oid, frame)
-                out.append(("shm", len(frame)))
+                # Zero-copy seal straight into the shm arena.
+                self.shm.create_and_seal_serialized(oid, serialized)
+                out.append(("shm", size))
         return out
 
     def _execute_one(self, msg) -> None:
@@ -263,7 +315,7 @@ class WorkerRuntime:
 
                 env_undo = apply_runtime_env(payload["runtime_env"])
             resolved = {
-                i: self._materialize(entry)
+                i: self._materialize(entry, priority=2)
                 for i, entry in payload.get("resolved_args", {}).items()
             }
             args, kwargs = self._resolve_args(payload["args_frame"], resolved)
@@ -419,3 +471,9 @@ def worker_entry(conn, worker_id_hex: str, node_id_hex: str, env: dict) -> None:
         _worker_runtime.run_task_loop()
     except KeyboardInterrupt:
         pass
+    finally:
+        # Outbound replies are sent by an async sender thread: flush the
+        # tail (final task-done replies on drain_exit) before the process
+        # exits, or callers hang on results that were computed but never
+        # hit the pipe.
+        _worker_runtime.flush_outbound()

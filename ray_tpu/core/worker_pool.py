@@ -28,7 +28,8 @@ class WorkerHandle:
     DEDICATED = "DEDICATED"  # bound to an actor for its lifetime
     DEAD = "DEAD"
 
-    def __init__(self, worker_id: WorkerID, node_id: NodeID, process, conn):
+    def __init__(self, worker_id: WorkerID, node_id: NodeID, process, conn,
+                 pool: "WorkerPool" = None):
         self.worker_id = worker_id
         self.node_id = node_id
         self.process = process
@@ -40,8 +41,29 @@ class WorkerHandle:
         self._send_lock = threading.Lock()
         self._registered = threading.Event()
         self._handler_thread: Optional[threading.Thread] = None
+        self._pool = pool
+        self._sendq: List = []
+        self._send_queued = False
 
     def send(self, msg) -> bool:
+        """Enqueue for the pool's sender thread, which coalesces bursts
+        into one pipe frame (reference: batched task pushes amortizing
+        per-RPC overhead in ``direct_task_transport``). Optimistic True:
+        pipe failures surface via the reader loop's death path."""
+        if self.state == WorkerHandle.DEAD:
+            return False
+        pool = self._pool
+        if pool is None or pool._stopped.is_set():
+            return self._raw_send(msg)
+        with pool._send_cond:
+            self._sendq.append(msg)
+            if not self._send_queued:
+                self._send_queued = True
+                pool._send_pending.append(self)
+            pool._send_cond.notify()
+        return True
+
+    def _raw_send(self, msg) -> bool:
         with self._send_lock:
             try:
                 self.conn.send(msg)
@@ -55,7 +77,7 @@ class WorkerHandle:
     def kill(self) -> None:
         self.state = WorkerHandle.DEAD
         try:
-            self.send(("exit",))
+            self._raw_send(("exit",))  # direct: must reach the pipe now
         except Exception:
             pass
         if self.process.is_alive():
@@ -85,12 +107,38 @@ class WorkerPool:
         # Spawns decided but not yet inserted into _workers; counted against
         # the pool cap so concurrent check-then-spawn paths can't overshoot.
         self._pending_spawns = 0
+        # Outbound sender: workers with queued messages, drained by one
+        # thread that coalesces per-worker bursts into single pipe frames.
+        self._send_cond = threading.Condition()
+        self._send_pending: List[WorkerHandle] = []
+        self._sender_thread = threading.Thread(
+            target=self._sender_loop, daemon=True, name="rt-pool-sender")
+        self._sender_thread.start()
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, prestart: bool = True) -> None:
         if prestart:
             for _ in range(self.size):
                 self._start_worker()
+
+    def _sender_loop(self) -> None:
+        while True:
+            with self._send_cond:
+                while not self._send_pending and not self._stopped.is_set():
+                    self._send_cond.wait()
+                if self._stopped.is_set() and not self._send_pending:
+                    return
+                batches = []
+                for w in self._send_pending:
+                    msgs, w._sendq = w._sendq, []
+                    w._send_queued = False
+                    if msgs:
+                        batches.append((w, msgs))
+                self._send_pending.clear()
+            for w, msgs in batches:
+                if w.state == WorkerHandle.DEAD:
+                    continue
+                w._raw_send(msgs[0] if len(msgs) == 1 else ("batch", msgs))
 
     def _start_worker(self) -> WorkerHandle:
         from .worker_main import worker_entry
@@ -105,7 +153,8 @@ class WorkerPool:
         )
         proc.start()
         child_conn.close()
-        handle = WorkerHandle(worker_id, self.node_id, proc, parent_conn)
+        handle = WorkerHandle(worker_id, self.node_id, proc, parent_conn,
+                              pool=self)
         with self._lock:
             self._workers[worker_id] = handle
         t = threading.Thread(
@@ -120,9 +169,11 @@ class WorkerPool:
         try:
             while not self._stopped.is_set():
                 msg = worker.conn.recv()
-                if msg[0] == "register":
-                    worker._registered.set()
-                self._message_handler(worker, msg)
+                msgs = msg[1] if msg[0] == "batch" else (msg,)
+                for m in msgs:
+                    if m[0] == "register":
+                        worker._registered.set()
+                    self._message_handler(worker, m)
         except (EOFError, OSError):
             pass
         if not self._stopped.is_set() and worker.state != WorkerHandle.DEAD:
@@ -255,6 +306,8 @@ class WorkerPool:
 
     def shutdown(self) -> None:
         self._stopped.set()
+        with self._send_cond:
+            self._send_cond.notify_all()
         with self._lock:
             workers = list(self._workers.values())
         for w in workers:

@@ -51,6 +51,14 @@ class GPT2Config:
     # while-loop overhead (XLA sequencing + carry copies per step).
     scan_unroll: int = 1
     sp_axis: str = "sp"
+    # MoE (expert-parallel) FFN: >0 replaces every block's dense MLP with
+    # a top-k routed mixture over ``num_experts`` experts sharded on the
+    # ``ep`` mesh axis (parallel/moe.py all_to_all dispatch).
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    ep_axis: str = "ep"
 
     @property
     def mlp_dim(self) -> int:
@@ -89,8 +97,8 @@ def init_params(key, cfg: GPT2Config) -> Tuple[Dict, Dict]:
     proj_std = 0.02 / math.sqrt(2 * L)
 
     def layer_init(k):
-        ks = jax.random.split(k, 4)
-        return {
+        ks = jax.random.split(k, 5)
+        base = {
             "ln1_scale": jnp.ones((L, d)),
             "ln1_bias": jnp.zeros((L, d)),
             "qkv_w": truncated_normal(ks[0], (L, d, 3 * d)),
@@ -99,11 +107,24 @@ def init_params(key, cfg: GPT2Config) -> Tuple[Dict, Dict]:
             "proj_b": jnp.zeros((L, d)),
             "ln2_scale": jnp.ones((L, d)),
             "ln2_bias": jnp.zeros((L, d)),
-            "mlp_in_w": truncated_normal(ks[2], (L, d, m)),
-            "mlp_in_b": jnp.zeros((L, m)),
-            "mlp_out_w": truncated_normal(ks[3], (L, m, d), stddev=proj_std),
-            "mlp_out_b": jnp.zeros((L, d)),
         }
+        if cfg.num_experts > 0:
+            E = cfg.num_experts
+            base.update({
+                "router_w": truncated_normal(ks[2], (L, d, E)),
+                "moe_in_w": truncated_normal(ks[3], (L, E, d, m)),
+                "moe_out_w": truncated_normal(
+                    ks[4], (L, E, m, d), stddev=proj_std),
+            })
+        else:
+            base.update({
+                "mlp_in_w": truncated_normal(ks[2], (L, d, m)),
+                "mlp_in_b": jnp.zeros((L, m)),
+                "mlp_out_w": truncated_normal(
+                    ks[3], (L, m, d), stddev=proj_std),
+                "mlp_out_b": jnp.zeros((L, d)),
+            })
+        return base
 
     params = {
         "wte": truncated_normal(keys[0], (cfg.vocab_size, d)),
@@ -112,23 +133,33 @@ def init_params(key, cfg: GPT2Config) -> Tuple[Dict, Dict]:
         "lnf_scale": jnp.ones((d,)),
         "lnf_bias": jnp.zeros((d,)),
     }
-    axes = {
-        "wte": ("vocab", "embed"),
-        "wpe": (None, "embed"),
-        "blocks": {
-            "ln1_scale": ("layers", None),
-            "ln1_bias": ("layers", None),
-            "qkv_w": ("layers", "embed", "qkv"),
-            "qkv_b": ("layers", "qkv"),
-            "proj_w": ("layers", "qkv", "embed"),
-            "proj_b": ("layers", "embed"),
-            "ln2_scale": ("layers", None),
-            "ln2_bias": ("layers", None),
+    block_axes = {
+        "ln1_scale": ("layers", None),
+        "ln1_bias": ("layers", None),
+        "qkv_w": ("layers", "embed", "qkv"),
+        "qkv_b": ("layers", "qkv"),
+        "proj_w": ("layers", "qkv", "embed"),
+        "proj_b": ("layers", "embed"),
+        "ln2_scale": ("layers", None),
+        "ln2_bias": ("layers", None),
+    }
+    if cfg.num_experts > 0:
+        block_axes.update({
+            "router_w": ("layers", "embed", None),
+            "moe_in_w": ("layers", "expert", "embed", "mlp"),
+            "moe_out_w": ("layers", "expert", "mlp", "embed"),
+        })
+    else:
+        block_axes.update({
             "mlp_in_w": ("layers", "embed", "mlp"),
             "mlp_in_b": ("layers", "mlp"),
             "mlp_out_w": ("layers", "mlp", "embed"),
             "mlp_out_b": ("layers", "embed"),
-        },
+        })
+    axes = {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "blocks": block_axes,
         "lnf_scale": (None,),
         "lnf_bias": (None,),
     }
@@ -172,8 +203,56 @@ def _attend(q, k, v, cfg: GPT2Config, rules):
     return fn(q, k, v)
 
 
+def _moe_ffn(y, p, cfg: GPT2Config, rules):
+    """Expert-parallel FFN (parallel/moe.py): tokens are routed top-k and
+    dispatched to ``ep``-sharded experts with all_to_all. The batch rule
+    must include ``ep`` (each ep rank owns a distinct token shard — the
+    standard expert-parallel layout); non-expert params stay replicated
+    over ep and XLA inserts their gradient all-reduce. Returns (out, aux).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.moe import moe_ffn_local
+    from ..parallel.sharding import current_mesh, smap, spec_for
+
+    b, s, d = y.shape
+    mesh = current_mesh()
+    ep = cfg.ep_axis
+    have_ep = (mesh is not None and ep in mesh.axis_names
+               and dict(zip(mesh.axis_names, mesh.devices.shape))[ep] > 1)
+    if not have_ep:
+        out, aux = moe_ffn_local(
+            y.reshape(b * s, d), p["router_w"], p["moe_in_w"],
+            p["moe_out_w"], num_experts=cfg.num_experts,
+            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+            axis_name=None)
+        return out.reshape(b, s, d), aux
+
+    x_spec = spec_for(("batch", "seq", None), rules)
+    all_axes = tuple(mesh.axis_names)
+
+    def body(yb, rw, wi, wo):
+        bb, sb, dd = yb.shape
+        out, aux = moe_ffn_local(
+            yb.reshape(bb * sb, dd), rw, wi, wo,
+            num_experts=cfg.num_experts, top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor, axis_name=ep)
+        # aux differs per token shard: mean over the whole mesh so the
+        # out_spec can be replicated.
+        aux = jax.lax.pmean(aux, axis_name=all_axes)
+        return out.reshape(bb, sb, dd), aux
+
+    fn = smap(body, mesh,
+              in_specs=(x_spec, P(), spec_for(("expert",), rules),
+                        spec_for(("expert",), rules)),
+              out_specs=(x_spec, P()))
+    return fn(y, p["router_w"], p["moe_in_w"], p["moe_out_w"])
+
+
 def _block(x, p, cfg: GPT2Config, rules):
-    """One transformer block. x: [B, S, D]; p: this layer's param slice."""
+    """One transformer block. x: [B, S, D]; p: this layer's param slice.
+    Returns (x, aux_loss) — aux is 0 for dense blocks, the router
+    load-balance loss for MoE blocks."""
     b, s, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
 
@@ -194,6 +273,10 @@ def _block(x, p, cfg: GPT2Config, rules):
     x = x + constrain(o, ("batch", "seq", None), rules)
 
     y = layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    if cfg.num_experts > 0:
+        out, aux = _moe_ffn(y, p, cfg, rules)
+        return x + constrain(out, ("batch", "seq", None), rules), aux
+
     hdn = (y @ p["mlp_in_w"].astype(y.dtype)) + p["mlp_in_b"].astype(y.dtype)
     hdn = constrain(hdn, ("batch", "seq", "mlp"), rules)
     hdn = checkpoint_name(hdn, "mlp_in")
@@ -201,7 +284,8 @@ def _block(x, p, cfg: GPT2Config, rules):
     out = (hdn @ p["mlp_out_w"].astype(hdn.dtype)) + p["mlp_out_b"].astype(
         hdn.dtype
     )
-    return x + constrain(out, ("batch", "seq", None), rules)
+    x = x + constrain(out, ("batch", "seq", None), rules)
+    return x, jnp.zeros((), jnp.float32)
 
 
 def _embed_lookup(wte, tokens, rules):
@@ -273,30 +357,120 @@ def forward_features(params, tokens, cfg: GPT2Config, rules=None):
         else:
             block = jax.checkpoint(block)
 
+    aux = jnp.zeros((), jnp.float32)
     if cfg.scan_layers:
-        def scan_body(x, layer_params):
-            return block(x, layer_params), None
+        def scan_body(carry, layer_params):
+            x, aux = carry
+            x, a = block(x, layer_params)
+            return (x, aux + a), None
 
-        x, _ = jax.lax.scan(scan_body, x, params["blocks"],
-                            unroll=cfg.scan_unroll)
+        (x, aux), _ = jax.lax.scan(scan_body, (x, aux), params["blocks"],
+                                   unroll=cfg.scan_unroll)
     else:
         for i in range(cfg.num_layers):
             layer = jax.tree.map(lambda a: a[i], params["blocks"])
-            x = block(x, layer)
+            x, a = block(x, layer)
+            aux = aux + a
 
     x = layer_norm(x, params["lnf_scale"], params["lnf_bias"])
-    return x
+    return x, aux
 
 
 def forward(params, tokens, cfg: GPT2Config, rules=None):
     """tokens [B, S] -> logits [B, S, vocab]."""
-    x = forward_features(params, tokens, cfg, rules)
+    x, _ = forward_features(params, tokens, cfg, rules)
     # Tied LM head (fp32 logits for a stable loss).
     logits = jnp.einsum(
         "bsd,vd->bsv", x, params["wte"].astype(cfg.dtype),
         preferred_element_type=jnp.float32,
     )
     return constrain(logits, ("batch", "seq", "vocab"), rules)
+
+
+# Rules table that maps every logical axis to "replicated" — used inside
+# shard_map bodies (pp pipeline) where with_sharding_constraint is invalid.
+_NULL_RULES = None
+
+
+def _null_rules():
+    global _NULL_RULES
+    if _NULL_RULES is None:
+        from ..parallel.sharding import DEFAULT_RULES
+
+        _NULL_RULES = {k: None for k in DEFAULT_RULES}
+    return _NULL_RULES
+
+
+def _pp_axis_size(rules) -> int:
+    """Size of the pp mesh axis if the ambient mesh pipelines layers."""
+    from ..parallel.sharding import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None or "pp" not in mesh.axis_names:
+        return 1
+    if rules is None or rules.get("layers") != "pp":
+        return 1
+    return dict(zip(mesh.axis_names, mesh.devices.shape))["pp"]
+
+
+def _pp_forward_features(params, tokens, cfg: GPT2Config, rules):
+    """GPipe pipeline over the ``pp`` mesh axis: stage i owns layers
+    [i*L/pp, (i+1)*L/pp); microbatch activations hop stage-to-stage via
+    ppermute inside one compiled program (parallel/pipeline.py). Embedding
+    and final LN/head run replicated over pp (cheap vs the blocks).
+
+    Enabled by rules {"layers": "pp"} on a mesh with pp>1 — the same
+    ``loss_fn`` entrypoint dispatches here, so the Trainer selects
+    pipeline parallelism purely through its ScalingConfig mesh axes.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.pipeline import num_microbatches_for, pipeline_apply_local
+    from ..parallel.sharding import current_mesh, smap, spec_for
+
+    if cfg.num_experts > 0:
+        raise NotImplementedError(
+            "pp+MoE is not supported yet: the pipeline carry does not "
+            "thread the router aux loss, which would silently disable "
+            "load balancing — train MoE with dp/fsdp/ep axes instead")
+    mesh = current_mesh()
+    pp = _pp_axis_size(rules)
+    b, s = tokens.shape
+
+    wte = constrain(params["wte"], (None, None), rules)
+    wpe = constrain(params["wpe"], (None, None), rules)
+    x = _embed_lookup(wte, tokens, rules)
+    x = x.astype(cfg.dtype) + wpe[:s].astype(cfg.dtype)[None]
+
+    m = num_microbatches_for(b, pp)
+    micro = x.reshape(m, b // m, s, x.shape[-1])
+
+    null = _null_rules()
+    block = partial(_block, cfg=cfg, rules=null)
+    if cfg.remat and cfg.remat_policy != "none":
+        block = jax.checkpoint(block)
+
+    def stage_fn(stage_params, xmb):
+        def body(xc, layer):
+            xc, _ = block(xc, layer)
+            return xc, None
+
+        y, _ = jax.lax.scan(body, xmb, stage_params)
+        return y
+
+    blocks_spec = jax.tree.map(lambda _: P("pp"), params["blocks"])
+    data_spec = spec_for((None, "batch", "seq", None), rules)
+
+    def pp_body(blocks_local, micro_local):
+        return pipeline_apply_local(stage_fn, blocks_local, micro_local,
+                                    axis_name="pp")
+
+    fn = smap(pp_body, mesh, in_specs=(blocks_spec, data_spec),
+              out_specs=data_spec)
+    out = fn(params["blocks"], micro)
+    x = out.reshape(b, s, -1)
+    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    return x, jnp.zeros((), jnp.float32)
 
 
 def loss_fn(params, batch, cfg: GPT2Config, rules=None,
@@ -312,7 +486,10 @@ def loss_fn(params, batch, cfg: GPT2Config, rules=None,
     """
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    x = forward_features(params, inputs, cfg, rules)
+    if _pp_axis_size(rules) > 1:
+        x, aux = _pp_forward_features(params, inputs, cfg, rules)
+    else:
+        x, aux = forward_features(params, inputs, cfg, rules)
     d = x.shape[-1]
     wte = params["wte"].astype(cfg.dtype)
 
@@ -345,7 +522,10 @@ def loss_fn(params, batch, cfg: GPT2Config, rules=None,
     (nll_sum, denom), _ = jax.lax.scan(
         chunk_loss, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
         (xc, tc))
-    return nll_sum / jnp.maximum(denom, 1.0)
+    loss = nll_sum / jnp.maximum(denom, 1.0)
+    if cfg.num_experts > 0:
+        loss = loss + cfg.moe_aux_weight * aux / cfg.num_layers
+    return loss
 
 
 def flops_per_token(cfg: GPT2Config, seq: int) -> float:

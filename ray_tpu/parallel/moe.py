@@ -89,16 +89,17 @@ def moe_ffn_local(x, router_w, w_in, w_out, *, num_experts: int,
     dispatch, combine = _dispatch_mask(gate_idx, gate_vals, num_experts,
                                        capacity)
 
-    # Gather expert inputs: [E, C, model]
+    # Gather expert inputs: [E, C, model]. Device d owns global experts
+    # [d*e_local, (d+1)*e_local) — device-major numbering matching the
+    # router's global expert ids.
     expert_in = jnp.einsum("tec,tm->ecm", dispatch, x.astype(jnp.float32))
     if axis_name and ep > 1:
-        # all_to_all: each device keeps its local experts' slices of every
-        # device's tokens -> [e_local, ep*C, model].
-        expert_in = expert_in.reshape(ep, e_local, capacity, model)
+        # Tiled all_to_all: split the expert dim into ep pieces (piece j =
+        # dev j's experts, device-major) and concat received pieces along
+        # the slot dim: [E, C, m] -> [e_local, ep*C, m], slot dim in
+        # source-device-major blocks of C.
         expert_in = jax.lax.all_to_all(expert_in, axis_name, split_axis=0,
-                                       concat_axis=2, tiled=False)
-        # [e_local, ep, C, model] after a2a with split on leading ep dim:
-        expert_in = expert_in.reshape(e_local, ep * capacity, model)
+                                       concat_axis=1, tiled=True)
     else:
         expert_in = expert_in.reshape(e_local, capacity, model)
 
@@ -108,13 +109,11 @@ def moe_ffn_local(x, router_w, w_in, w_out, *, num_experts: int,
     y = jnp.einsum("ech,ehm->ecm", h, w_out.astype(jnp.float32))
 
     if axis_name and ep > 1:
-        # Return a2a: redistribute each expert's outputs back to the token
-        # owners; leading dim becomes the full expert set again, grouped
-        # [ep, e_local] matching dispatch's expert order.
-        y = y.reshape(e_local, ep, capacity, model)
+        # Strict inverse: split the slot dim back into its ep source
+        # blocks and concat along the expert dim -> [E, C, m] with
+        # device-major expert ids again.
         y = jax.lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0,
-                               tiled=False)
-        y = y.reshape(num_experts, capacity, model)
+                               tiled=True)
     else:
         y = y.reshape(num_experts, capacity, model)
 

@@ -94,10 +94,78 @@ def cmd_dashboard(args) -> int:
         return 0
 
 
+def cmd_start(args) -> int:
+    """Assemble a cluster from shells (reference: ``ray start``,
+    scripts.py:532 + services.py:1440).
+
+    ``rt start --head`` runs the head in the foreground: runtime +
+    cluster listener (worker hosts dial it) + client server (drivers
+    connect with ``ray_tpu.client.connect``). ``rt start
+    --address=<head>`` runs a self-registering node daemon the head
+    adopts."""
+    import json as json_mod
+    import time
+
+    if args.head:
+        import ray_tpu as rt
+        from ray_tpu.client.server import ClientServer
+        from ray_tpu.core.runtime import get_head_runtime
+
+        rt.init(num_cpus=args.num_cpus or 2)
+        runtime = get_head_runtime()
+        runtime._ensure_cluster_listener(args.host, args.port)
+        server = ClientServer(host=args.host, port=args.client_port)
+        server.start()
+        print(json_mod.dumps({
+            "cluster_address": runtime._cluster_addr,
+            "client_address": "%s:%d" % server.address,
+        }), flush=True)
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+        rt.shutdown()
+        return 0
+
+    if not args.address:
+        print("rt start needs --head or --address=<head-host:port>",
+              file=sys.stderr)
+        return 2
+    from ray_tpu.core.ids import NodeID
+    from ray_tpu.core.node_daemon import main as daemon_main
+
+    resources = {"CPU": float(args.num_cpus or 2)}
+    if args.resources:
+        resources.update(json.loads(args.resources))
+    node_id = NodeID.from_random()
+    print(json.dumps({"node_id": node_id.hex(), "address": args.address}),
+          flush=True)
+    return daemon_main([
+        "--driver", args.address,
+        "--node-id", node_id.hex(),
+        "--num-workers", str(args.num_workers),
+        "--resources-json", json.dumps(resources),
+    ])
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="rt", description=__doc__)
     p.add_argument("--num-cpus", type=float, default=None)
     sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("start", help="start a head or join a cluster "
+                                      "(foreground; reference: ray start)")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default="",
+                    help="head cluster address to join (host:port)")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=6380,
+                    help="cluster listener port (head)")
+    sp.add_argument("--client-port", type=int, default=10001)
+    sp.add_argument("--num-workers", type=int, default=2)
+    sp.add_argument("--resources", default="",
+                    help='extra resources JSON, e.g. \'{"TPU": 8}\'')
 
     sub.add_parser("status", help="cluster resource/task/actor summary")
     lp = sub.add_parser("list", help="list cluster entities")
@@ -116,6 +184,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     handlers = {
+        "start": cmd_start,
         "status": cmd_status,
         "list": cmd_list,
         "memory": cmd_memory,

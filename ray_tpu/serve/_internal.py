@@ -50,12 +50,23 @@ class DeploymentInfo:
     autoscaling: Optional[AutoscalingConfig] = None
     ray_actor_options: dict = field(default_factory=dict)
     version: int = 0
+    request_timeout_s: Optional[float] = None
 
 
 class _Replica:
-    """Replica actor body (reference: RayServeReplica)."""
+    """Replica actor body (reference: RayServeReplica).
 
-    def __init__(self, deployment_def, init_args, init_kwargs):
+    Request methods are ASYNC: the actor machinery runs every coroutine
+    method on the replica's ONE persistent asyncio event loop (see
+    ``core/worker_main.py`` async-actor support), so concurrent requests
+    interleave at awaits instead of each spinning up a throwaway loop —
+    the asyncio request plane of ``serve/_private/replica.py``. Streaming
+    responses register a (async) generator under a stream id which the
+    caller drains with ``next_chunks`` (chunked-pull streaming).
+    """
+
+    def __init__(self, deployment_def, init_args, init_kwargs,
+                 request_timeout_s: Optional[float] = None):
         import inspect
 
         if inspect.isclass(deployment_def):
@@ -64,34 +75,106 @@ class _Replica:
             self.callable = deployment_def
         self._ongoing = 0
         self._total = 0
+        self._timeout = request_timeout_s
+        self._streams: Dict[int, Any] = {}
+        self._stream_counter = 0
 
-    def handle_request(self, args, kwargs):
+    async def _invoke(self, fn, args, kwargs):
+        import asyncio
+        import functools
+        import inspect
+
+        target = fn.__call__ if not inspect.isfunction(fn) and not \
+            inspect.ismethod(fn) and callable(fn) else fn
+        if inspect.iscoroutinefunction(target):
+            coro = fn(*args, **kwargs)
+            result = await (asyncio.wait_for(coro, self._timeout)
+                            if self._timeout else coro)
+        else:
+            # Sync handlers run off-loop so concurrent requests (e.g.
+            # @serve.batch coalescing) aren't serialized behind the
+            # replica's event loop.
+            loop = asyncio.get_running_loop()
+            call = loop.run_in_executor(
+                None, functools.partial(fn, *args, **kwargs))
+            result = await (asyncio.wait_for(call, self._timeout)
+                            if self._timeout else call)
+            if inspect.iscoroutine(result):
+                result = await (asyncio.wait_for(result, self._timeout)
+                                if self._timeout else result)
+        if inspect.isgenerator(result) or inspect.isasyncgen(result):
+            self._sweep_streams()
+            self._stream_counter += 1
+            self._streams[self._stream_counter] = (result, time.monotonic())
+            return ("__rt_stream__", self._stream_counter)
+        return result
+
+    def _sweep_streams(self, idle_s: float = 300.0) -> None:
+        """Close streams abandoned by their consumer (client disconnect,
+        dropped StreamingResponse) so generators don't leak for the
+        replica's lifetime. Lazy sweep on registration — no timers."""
+        now = time.monotonic()
+        for sid in [s for s, (_, t) in self._streams.items()
+                    if now - t > idle_s]:
+            gen, _ = self._streams.pop(sid)
+            try:
+                close = getattr(gen, "close", None) or getattr(
+                    gen, "aclose", None)
+                if close is not None:
+                    res = close()
+                    if hasattr(res, "__await__"):
+                        import asyncio
+
+                        asyncio.ensure_future(res)
+            except Exception:
+                pass
+
+    async def handle_request(self, args, kwargs):
         self._ongoing += 1
         self._total += 1
         try:
             fn = self.callable
             if not callable(fn):
                 raise TypeError("deployment is not callable")
-            if hasattr(fn, "__call__") and not isinstance(fn, type):
-                result = fn(*args, **kwargs)
-            else:
-                result = fn(*args, **kwargs)
-            import inspect
-
-            if inspect.iscoroutine(result):
-                import asyncio
-
-                result = asyncio.new_event_loop().run_until_complete(result)
-            return result
+            return await self._invoke(fn, args, kwargs)
         finally:
             self._ongoing -= 1
 
-    def call_method(self, method, args, kwargs):
+    async def call_method(self, method, args, kwargs):
         self._ongoing += 1
+        self._total += 1
         try:
-            return getattr(self.callable, method)(*args, **kwargs)
+            return await self._invoke(
+                getattr(self.callable, method), args, kwargs)
         finally:
             self._ongoing -= 1
+
+    async def next_chunks(self, stream_id: int, max_n: int = 8):
+        """Drain up to ``max_n`` items from a registered stream; returns
+        (done, items). The stream is dropped when exhausted."""
+        import inspect
+
+        entry = self._streams.get(stream_id)
+        if entry is None:
+            return True, []
+        gen = entry[0]
+        self._streams[stream_id] = (gen, time.monotonic())
+        items = []
+        try:
+            if inspect.isasyncgen(gen):
+                async for item in gen:
+                    items.append(item)
+                    if len(items) >= max_n:
+                        return False, items
+            else:
+                for item in gen:
+                    items.append(item)
+                    if len(items) >= max_n:
+                        return False, items
+        finally:
+            if len(items) < max_n:
+                self._streams.pop(stream_id, None)
+        return True, items
 
     def metrics(self):
         return {"ongoing": self._ongoing, "total": self._total}
@@ -305,7 +388,8 @@ class ServeController:
             actor = replica_cls.options(
                 max_concurrency=max(2, info.max_concurrent_queries),
                 **opts,
-            ).remote(info.deployment_def, info.init_args, info.init_kwargs)
+            ).remote(info.deployment_def, info.init_args, info.init_kwargs,
+                     request_timeout_s=info.request_timeout_s)
             current.append(actor)
         while len(current) > target:
             victim = current.pop()
@@ -392,8 +476,13 @@ class Router:
         self._stop.set()
 
     def assign(self, method: Optional[str], args, kwargs):
+        return self.assign_with_replica(method, args, kwargs)[0]
+
+    def assign_with_replica(self, method: Optional[str], args, kwargs):
         """Pick a replica with a free slot; block (condvar, woken by
-        completions and replica-set updates) when all are at capacity."""
+        completions and replica-set updates) when all are at capacity.
+        Returns (result_ref, replica_handle) — the replica is needed to
+        drain streaming responses (``_Replica.next_chunks``)."""
         deadline = time.monotonic() + 30
         self._ensure_replicas()
         while True:
@@ -434,7 +523,7 @@ class Router:
             from ..core import on_ref_ready
 
             on_ref_ready(ref, lambda k=key: self._release(k))
-            return ref
+            return ref, replica
 
     def _release(self, key: bytes) -> None:
         with self._slot_free:

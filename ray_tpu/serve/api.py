@@ -9,8 +9,10 @@ place of uvicorn/starlette (same per-node proxy role as
 
 from __future__ import annotations
 
+import asyncio
 import functools
 import json
+import sys
 import threading
 import time
 from dataclasses import dataclass, field
@@ -85,6 +87,42 @@ def _controller():
     return _state["controller"]
 
 
+def _is_stream_marker(value) -> bool:
+    return (isinstance(value, tuple) and len(value) == 2
+            and value[0] == "__rt_stream__")
+
+
+class StreamingResponse:
+    """Iterator over a streaming deployment response (the replica holds
+    the generator; chunks are pulled via ``_Replica.next_chunks``).
+    Reference: Serve's ASGI StreamingResponse — here as chunked pull."""
+
+    def __init__(self, replica, stream_id: int, chunk_size: int = 8):
+        self._replica = replica
+        self._stream_id = stream_id
+        self._chunk = chunk_size
+        self._buf: List[Any] = []
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self._buf:
+            if self._done:
+                raise StopIteration
+            done, items = get(
+                self._replica.next_chunks.remote(
+                    self._stream_id, self._chunk),
+                timeout=60,
+            )
+            self._done = done
+            self._buf = list(items)
+            if not self._buf:
+                raise StopIteration
+        return self._buf.pop(0)
+
+
 class DeploymentHandle:
     """Python-side handle (reference: serve/handle.py ServeHandle)."""
 
@@ -95,6 +133,18 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs):
         return self._router.assign(None, args, kwargs)
+
+    def stream(self, *args, **kwargs) -> StreamingResponse:
+        """Call a streaming deployment (one returning a generator /
+        async generator); returns an iterator over its chunks."""
+        ref, replica = self._router.assign_with_replica(None, args, kwargs)
+        value = get(ref, timeout=60)
+        if not _is_stream_marker(value):
+            single = StreamingResponse(replica, -1)
+            single._buf = [value]
+            single._done = True
+            return single
+        return StreamingResponse(replica, value[1])
 
     def method(self, method_name: str) -> "DeploymentMethodHandle":
         return DeploymentMethodHandle(self, method_name)
@@ -156,6 +206,7 @@ class Deployment:
             route_prefix=o.get("route_prefix", f"/{self.name}"),
             autoscaling=autoscaling,
             ray_actor_options=o.get("ray_actor_options", {}),
+            request_timeout_s=o.get("request_timeout_s"),
         )
         get(_controller().deploy.remote(info), timeout=60)
         return DeploymentHandle(self.name, o.get("max_concurrent_queries",
@@ -172,7 +223,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_concurrent_queries: int = 100,
                route_prefix: Optional[str] = None,
                autoscaling_config=None,
-               ray_actor_options: Optional[dict] = None):
+               ray_actor_options: Optional[dict] = None,
+               request_timeout_s: Optional[float] = None):
     """``@serve.deployment`` decorator (reference: serve/api.py)."""
 
     def wrap(target):
@@ -182,6 +234,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             "route_prefix": route_prefix,
             "autoscaling_config": autoscaling_config,
             "ray_actor_options": ray_actor_options or {},
+            "request_timeout_s": request_timeout_s,
         })
 
     if _func_or_class is not None:
@@ -209,74 +262,202 @@ def list_deployments() -> Dict[str, dict]:
 
 # -- HTTP proxy --------------------------------------------------------------
 
-def _start_http_proxy(host: str, port: int) -> None:
-    """Threaded stdlib HTTP proxy (role of http_proxy.py HTTPProxy actor)."""
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+class _AsyncHTTPProxy:
+    """Asyncio HTTP/1.1 proxy (role of ``http_proxy.py:189`` HTTPProxy —
+    uvicorn replaced by an asyncio.start_server loop; stdlib only).
 
-    handles: Dict[str, DeploymentHandle] = {}
+    One event loop serves every connection with keep-alive; replica
+    results resolve through ``on_ref_ready`` callbacks bridged to the
+    loop (never a parked thread per request). Streaming responses are
+    written with chunked transfer encoding as chunks are pulled from the
+    replica.
+    """
 
-    class ProxyHandler(BaseHTTPRequestHandler):
-        def log_message(self, *args):
-            pass
+    def __init__(self, host: str, port: int):
+        import asyncio
 
-        def _route(self):
-            path = self.path.split("?")[0].strip("/")
-            parts = path.split("/")
-            name = parts[0] if parts and parts[0] else None
-            if name is None:
-                self.send_response(404)
-                self.end_headers()
-                self.wfile.write(b'{"error": "no deployment in path"}')
-                return
-            length = int(self.headers.get("Content-Length", 0) or 0)
-            body = self.rfile.read(length) if length else b""
-            payload = None
-            if body:
-                try:
-                    payload = json.loads(body)
-                except json.JSONDecodeError:
-                    payload = body.decode("utf-8", "replace")
+        self._host = host
+        self._port = port
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._loop = asyncio.new_event_loop()
+        self._server = None
+        self._started = threading.Event()
+        self._ok = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serve-http")
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    def _run(self):
+        import asyncio
+
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
             try:
-                handle = handles.get(name)
-                if handle is None:
-                    names = get(
-                        _controller().get_deployment_names.remote(),
-                        timeout=10,
-                    )
-                    if name not in names:
-                        self.send_response(404)
-                        self.end_headers()
-                        self.wfile.write(
-                            json.dumps({"error": f"unknown deployment "
-                                                 f"{name}"}).encode())
-                        return
-                    handle = DeploymentHandle(name)
-                    handles[name] = handle
-                if payload is None:
-                    ref = handle.remote()
-                else:
-                    ref = handle.remote(payload)
-                result = get(ref, timeout=60)
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.end_headers()
-                self.wfile.write(json.dumps(result).encode())
-            except Exception as e:  # noqa: BLE001
-                self.send_response(500)
-                self.end_headers()
-                self.wfile.write(json.dumps({"error": str(e)}).encode())
+                self._server = await asyncio.start_server(
+                    self._serve_conn, self._host, self._port)
+                self._ok = True
+            except OSError:
+                self._ok = False  # port busy; python handles still work
+            self._started.set()
 
-        do_GET = _route
-        do_POST = _route
+        self._loop.run_until_complete(boot())
+        if self._ok:
+            self._loop.run_forever()
 
-    try:
-        server = ThreadingHTTPServer((host, port), ProxyHandler)
-    except OSError:
-        return  # port busy (another instance); python handles still work
-    _state["http_server"] = server
-    t = threading.Thread(target=server.serve_forever, daemon=True,
-                         name="serve-http")
-    t.start()
+    def shutdown(self):
+        import asyncio
+
+        if self._ok and self._loop.is_running():
+            def _stop():
+                if self._server is not None:
+                    self._server.close()
+                self._loop.stop()
+            self._loop.call_soon_threadsafe(_stop)
+
+    async def _aget(self, ref, timeout: float = 60.0):
+        """Await an ObjectRef on the event loop: on_ref_ready bridges the
+        completion callback; the final get() is then non-blocking."""
+        import asyncio
+
+        from ..core import on_ref_ready
+
+        loop = self._loop
+        fut = loop.create_future()
+
+        def _done():
+            if not fut.done():
+                fut.set_result(None)
+
+        on_ref_ready(ref, lambda: loop.call_soon_threadsafe(_done))
+        await asyncio.wait_for(fut, timeout)
+        return get(ref, timeout=5)
+
+    async def _serve_conn(self, reader, writer):
+        try:
+            while True:
+                req = await reader.readline()
+                if not req:
+                    return
+                try:
+                    method, target, _version = req.decode().split()
+                except ValueError:
+                    return
+                headers = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                length = int(headers.get("content-length", 0) or 0)
+                body = await reader.readexactly(length) if length else b""
+                keep = headers.get("connection", "keep-alive") != "close"
+                keep = await self._route(writer, target, body, keep) and keep
+                await writer.drain()
+                if not keep:
+                    return
+        except (ConnectionError, TimeoutError, EOFError,
+                asyncio.IncompleteReadError):
+            pass  # client went away
+        except Exception:
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _write_simple(self, writer, status: int, payload: bytes,
+                      keep: bool) -> None:
+        conn = b"keep-alive" if keep else b"close"
+        writer.write(
+            b"HTTP/1.1 %d %s\r\nContent-Type: application/json\r\n"
+            b"Content-Length: %d\r\nConnection: %s\r\n\r\n%s"
+            % (status, b"OK" if status == 200 else b"ERR",
+               len(payload), conn, payload))
+
+    async def _route(self, writer, target: str, body: bytes,
+                     keep: bool) -> bool:
+        """Handle one request. Returns False when the connection must be
+        closed (e.g. a failure after a chunked response started — a 500
+        cannot be written into the middle of a chunked body)."""
+        name = target.split("?")[0].strip("/").split("/")[0]
+        if not name:
+            self._write_simple(
+                writer, 404, b'{"error": "no deployment in path"}', keep)
+            return True
+        payload = None
+        if body:
+            try:
+                payload = json.loads(body)
+            except json.JSONDecodeError:
+                payload = body.decode("utf-8", "replace")
+        try:
+            handle = self._handles.get(name)
+            if handle is None:
+                names = await self._aget(
+                    _controller().get_deployment_names.remote(), 10)
+                if name not in names:
+                    self._write_simple(
+                        writer, 404,
+                        json.dumps(
+                            {"error": f"unknown deployment {name}"}
+                        ).encode(), keep)
+                    return True
+                handle = DeploymentHandle(name)
+                self._handles[name] = handle
+            # assign() can block on max_concurrent_queries backpressure —
+            # run it off-loop so one saturated deployment doesn't stall
+            # other connections.
+            args = () if payload is None else (payload,)
+            ref, replica = await self._loop.run_in_executor(
+                None, lambda: handle._router.assign_with_replica(
+                    None, args, {}))
+            result = await self._aget(ref, 60)
+        except Exception as e:  # noqa: BLE001
+            try:
+                self._write_simple(
+                    writer, 500, json.dumps({"error": str(e)}).encode(),
+                    keep)
+            except Exception:
+                return False
+            return True
+        if _is_stream_marker(result):
+            try:
+                await self._write_stream(writer, replica, result[1], keep)
+            except Exception:
+                # Mid-stream failure: the chunked body is unterminated —
+                # drop the connection so framing can't desync.
+                return False
+            return True
+        self._write_simple(writer, 200, json.dumps(result).encode(), keep)
+        return True
+
+    async def _write_stream(self, writer, replica, stream_id: int,
+                            keep: bool) -> None:
+        conn = b"keep-alive" if keep else b"close"
+        writer.write(
+            b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+            b"Transfer-Encoding: chunked\r\nConnection: %s\r\n\r\n" % conn)
+        done = False
+        while not done:
+            done, items = await self._aget(
+                replica.next_chunks.remote(stream_id, 8), 60)
+            for item in items:
+                chunk = json.dumps(item).encode() + b"\n"
+                writer.write(b"%x\r\n%s\r\n" % (len(chunk), chunk))
+            await writer.drain()
+        writer.write(b"0\r\n\r\n")
+
+
+def _start_http_proxy(host: str, port: int) -> None:
+    proxy = _AsyncHTTPProxy(host, port)
+    if proxy._ok:
+        _state["http_server"] = proxy
 
 
 # -- batching ----------------------------------------------------------------

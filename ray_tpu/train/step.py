@@ -9,9 +9,8 @@ the mesh + logical-axis rules. No process groups, no wrapper hooks.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
 from functools import partial
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -24,16 +23,6 @@ from ..parallel.sharding import (
     shardings_for,
     spec_for,
 )
-
-
-@dataclass
-class TrainState:
-    params: Any
-    opt_state: Any
-    step: Any  # scalar int array
-
-    def tree_flatten(self):  # manual pytree (kept simple: use as a dict)
-        raise NotImplementedError
 
 
 def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,

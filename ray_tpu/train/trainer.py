@@ -67,6 +67,12 @@ class BackendExecutor:
             poll_interval: float = 0.2,
             loaded_checkpoint: Optional[Checkpoint] = None) -> List[Any]:
         assert self.worker_group is not None, "call start() first"
+        if self.scaling.mesh is not None:
+            # The ScalingConfig's mesh layout is the worker's parallelism
+            # contract — surface it in the train config so train_funcs
+            # build exactly the requested dp/fsdp/pp/sp/tp/ep mesh.
+            config = dict(config or {})
+            config.setdefault("mesh_spec", self.scaling.mesh)
         if loaded_checkpoint is not None:
             self.worker_group.setup_sessions(
                 loaded_checkpoint=loaded_checkpoint

@@ -1,0 +1,154 @@
+"""Cluster deploy surface: ``rt start --head`` / ``rt start --address``
+assembling a multi-host cluster from shells, and the TPU-pod autoscaler
+provider (reference: ``scripts/scripts.py:532`` ray start,
+``autoscaler/_private/gcp/node.py:187,547`` GCP TPU provider)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait_line(proc, timeout=120):
+    """Read one JSON line from a CLI process's stdout."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            time.sleep(0.1)
+            continue
+        line = line.strip()
+        if line.startswith(b"{"):
+            return json.loads(line)
+    raise TimeoutError("no JSON line from CLI process")
+
+
+@pytest.mark.timeout(600)
+def test_rt_start_assembles_two_node_cluster():
+    """Head + one worker host started as separate CLI subprocesses; a
+    driver connects through the client server and runs tasks that land
+    on the ADOPTED node's resources."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = []
+    try:
+        head = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.scripts.cli",
+             "--num-cpus", "2", "start", "--head", "--port", "0",
+             "--client-port", "0"],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        procs.append(head)
+        info = _wait_line(head)
+        cluster_addr = info["cluster_address"]
+        client_addr = info["client_address"]
+
+        worker = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.scripts.cli",
+             "--num-cpus", "2", "start", "--address", cluster_addr,
+             "--resources", '{"joined": 4}', "--num-workers", "1"],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        procs.append(worker)
+        _wait_line(worker)
+
+        from ray_tpu.client import connect
+
+        session = connect(client_addr)
+        try:
+            # The adopted node's custom resource must become schedulable.
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                if session.cluster_info()["resources"].get("joined", 0) >= 4:
+                    break
+                time.sleep(0.5)
+            res = session.cluster_info()["resources"]
+            assert res.get("joined", 0) >= 4, (
+                f"adopted node's resources never appeared: {res}")
+
+            @session.remote
+            def where():
+                return "ran"
+
+            ref = where.options(resources={"joined": 1}).remote()
+            assert session.get(ref, timeout=120) == "ran"
+        finally:
+            session.close()
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGINT)
+            except Exception:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except Exception:
+                p.kill()
+
+
+def test_tpu_pod_provider_launches_slice_for_mesh_claim_demand():
+    """A pending {"TPU": 8} demand (a v5e-8 mesh claim's bundle) makes
+    the autoscaler launch a v5e-8 pod slice through the (mock) TPU API."""
+    from ray_tpu.autoscaler.autoscaler import (
+        AutoscalerConfig,
+        LoadMetrics,
+        NodeType,
+        StandardAutoscaler,
+    )
+    from ray_tpu.autoscaler.providers import MockTPUPodAPI, TPUPodProvider
+
+    node_types = {
+        "v5e-8": NodeType(
+            name="v5e-8", resources={"TPU": 8.0, "CPU": 44.0},
+            max_workers=4,
+            topology={"accelerator_type": "v5e-8", "chips": 8},
+        ),
+    }
+    api = MockTPUPodAPI(ready_after=1)
+    provider = TPUPodProvider(api, node_types)
+    scaler = StandardAutoscaler(
+        provider, AutoscalerConfig(node_types=node_types, max_workers=4))
+
+    metrics = LoadMetrics()
+    # MeshClaim(v5e-8).to_bundles(8) == [{"TPU": 8.0}]
+    from ray_tpu.parallel.mesh import MeshClaim, MeshSpec
+
+    claim = MeshClaim(spec=MeshSpec(dp=8), slice_type="v5e-8")
+    metrics.set_pending_demands(claim.to_bundles(chips_per_host=8))
+
+    launched = scaler.update(metrics)
+    assert launched == {"v5e-8": 1}
+    assert api.create_calls and api.create_calls[0][1] == "v5e-8"
+    # Slice transitions CREATING -> READY across polls; it counts as a
+    # non-terminated node either way (no duplicate launches).
+    nodes = provider.non_terminated_nodes()
+    assert len(nodes) == 1 and nodes[0].node_type == "v5e-8"
+    launched2 = scaler.update(metrics)
+    # Demand now fits the planned/running slice's capacity once READY;
+    # the provider must not thrash more slices than max_workers allows.
+    assert sum(launched2.values()) <= 1
+    nodes = provider.non_terminated_nodes()
+    assert nodes[0].tags["state"] == "READY"
+
+
+def test_pending_placement_group_surfaces_as_autoscaler_demand(rt_init):
+    """LoadMetrics.from_runtime includes bundles of PENDING placement
+    groups — the path by which an unsatisfiable mesh claim reaches the
+    autoscaler."""
+    import ray_tpu as rt
+    from ray_tpu.autoscaler.autoscaler import LoadMetrics
+    from ray_tpu.core.runtime import get_head_runtime
+
+    pg = rt.placement_group([{"TPU": 8.0}], strategy="STRICT_PACK")
+    assert not pg.wait(timeout_seconds=1)  # no TPU node: stays pending
+    lm = LoadMetrics.from_runtime(get_head_runtime())
+    assert {"TPU": 8.0} in lm.pending_demands

@@ -152,3 +152,50 @@ def test_sliver_absorb_accounting():
         assert store.stats()["used_bytes"] == baseline
     finally:
         store.close()
+
+
+def test_create_object_write_seal_pinned_get():
+    """Two-phase zero-copy write (create_object/seal; reference: plasma
+    Create/Seal) + get_pinned lifetime: the pin releases when the last
+    derived view is collected, and delete-while-pinned defers the free."""
+    import gc
+
+    from ray_tpu._native import NativeStoreExists, NativeStoreFull
+
+    store = NativeStore.create("/rt_test_zc2", 1024 * 1024)
+    try:
+        arr = np.arange(4096, dtype=np.float64)
+        view = store.create_object(_key(50), arr.nbytes)
+        assert not view.readonly
+        view[:] = arr.tobytes()
+        view.release()
+        store.seal(_key(50))
+        try:
+            store.create_object(_key(50), 8)
+            raise AssertionError("duplicate create must raise")
+        except NativeStoreExists:
+            pass
+        g = store.get_pinned(_key(50))
+        assert g.readonly
+        out = np.frombuffer(g, dtype=np.float64)
+        np.testing.assert_array_equal(out, arr)
+        used = store.stats()["used_bytes"]
+        store.delete(_key(50))  # deferred: `out` still pins the extent
+        assert store.stats()["used_bytes"] == used
+        np.testing.assert_array_equal(out, arr)
+        del g, out
+        gc.collect()
+        assert store.stats()["used_bytes"] < used  # pin released on GC
+        # abort frees an unsealed reservation
+        v2 = store.create_object(_key(51), 512)
+        v2.release()
+        store.abort(_key(51))
+        assert store.get(_key(51)) is None
+        # oversized create reports full
+        try:
+            store.create_object(_key(52), 8 * 1024 * 1024)
+            raise AssertionError("oversized create must raise")
+        except NativeStoreFull:
+            pass
+    finally:
+        store.close()

@@ -119,3 +119,69 @@ def test_daemon_chaos_sigkill_retries():
         assert sorted(results) == list(range(16))
     finally:
         cluster.shutdown()
+
+
+def test_cross_daemon_transfer_is_peer_to_peer(daemon_cluster):
+    """Worker-to-worker object pulls must go daemon->daemon through the
+    holder's ObjectServer (PullManager), NOT relay through the head —
+    the head relay counter stays cold (reference: pull_manager.h:47,
+    push_manager.h:29 — raylets transfer directly)."""
+    cluster, _ = daemon_cluster
+    rtime = cluster.runtime
+    base = getattr(rtime, "relay_fetch_count", 0)
+
+    @rt.remote(resources={"zone_a": 0.1})
+    def produce(n):
+        return np.arange(n, dtype=np.int32)
+
+    @rt.remote(resources={"zone_b": 0.1})
+    def consume(arr):
+        return int(arr.sum())
+
+    n = 2 * 1024 * 1024 // 4
+    total = 0
+    refs = [produce.remote(n) for _ in range(3)]
+    total = rt.get([consume.remote(r) for r in refs], timeout=120)
+    assert total == [n * (n - 1) // 2] * 3
+    assert getattr(rtime, "relay_fetch_count", 0) == base, (
+        "cross-daemon pull used the head relay instead of P2P")
+
+
+def test_holder_daemon_killed_mid_pull_recovers_via_lineage():
+    """SIGKILL the daemon HOLDING an object while a consumer on another
+    daemon pulls it: the pull fails, the object is LOST, and lineage
+    reconstruction re-runs the producer so the consumer still finishes."""
+    import os
+    import signal
+
+    cluster = Cluster(head_node_args={
+        "num_cpus": 2,
+        "env": {"RT_FORCE_OBJECT_TRANSFER": "1"},
+    })
+    try:
+        holder = cluster.add_node(num_cpus=2, resources={"hold": 1.0},
+                                  remote=True)
+        cluster.add_node(num_cpus=2, resources={"use": 1.0}, remote=True)
+        cluster.wait_for_nodes()
+
+        @rt.remote(resources={"hold": 0.1}, max_retries=4)
+        def produce(n):
+            return np.ones(n, dtype=np.int64)
+
+        @rt.remote(resources={"use": 0.1}, max_retries=4)
+        def consume(arr):
+            return int(arr.sum())
+
+        n = 4 * 1024 * 1024 // 8
+        ref = produce.remote(n)
+        rt.wait([ref], num_returns=1, timeout=60)  # sealed on holder
+        out_ref = consume.remote(ref)
+        # Kill the holder while the consumer's pull is (likely) in flight.
+        holder_node = cluster.runtime.scheduler.get_node(holder)
+        os.kill(holder_node.process.pid, signal.SIGKILL)
+        # A replacement host joins (elastic recovery); lineage re-runs
+        # produce there and the consumer's pull completes.
+        cluster.add_node(num_cpus=2, resources={"hold": 1.0}, remote=True)
+        assert rt.get(out_ref, timeout=180) == n
+    finally:
+        cluster.shutdown()

@@ -248,3 +248,74 @@ def test_serve_survives_handle_gc(serve_instance):
     handle2 = serve.get_deployment_handle("Echo")
     assert rt.get(handle2.remote("b"), timeout=30) == {"echo": "b"}
     assert "Echo" in serve.list_deployments()
+
+
+def test_streaming_response_python_handle(serve_instance):
+    """Generator deployments stream: chunks are pulled from the replica
+    (``_Replica.next_chunks``) instead of materializing the whole body
+    (reference: Serve streaming responses)."""
+    serve = serve_instance
+
+    @serve.deployment
+    def streamer(n=5):
+        def gen():
+            for i in range(n):
+                yield {"chunk": i}
+        return gen()
+
+    handle = serve.run(streamer.bind())
+    out = list(handle.stream(4))
+    assert out == [{"chunk": i} for i in range(4)]
+
+
+def test_async_deployment_request_timeout(serve_instance):
+    """request_timeout_s cancels slow coroutine handlers (reference:
+    Serve request timeouts)."""
+    serve = serve_instance
+
+    @serve.deployment(request_timeout_s=0.3)
+    async def slow(x=None):
+        import asyncio
+
+        await asyncio.sleep(5)
+        return "never"
+
+    handle = serve.run(slow.bind())
+    from ray_tpu.core import get
+
+    with pytest.raises(Exception, match="(?i)timeout|cancel"):
+        get(handle.remote(), timeout=30)
+
+
+def test_max_concurrent_queries_cap_under_burst(serve_instance):
+    """Burst of requests >> cap: the replica must never observe more than
+    max_concurrent_queries ongoing requests (router enforcement,
+    reference: router.py:62,221)."""
+    serve = serve_instance
+
+    @serve.deployment(max_concurrent_queries=3)
+    class Tracker:
+        def __init__(self):
+            self.peak = 0
+            self.cur = 0
+
+        async def __call__(self, x=None):
+            import asyncio
+
+            self.cur += 1
+            self.peak = max(self.peak, self.cur)
+            await asyncio.sleep(0.05)
+            self.cur -= 1
+            return self.peak
+
+        async def peak_seen(self):
+            return self.peak
+
+    handle = serve.run(Tracker.bind())
+    from ray_tpu.core import get
+
+    refs = [handle.remote(i) for i in range(20)]
+    get(refs, timeout=60)
+    peak = get(handle.peak_seen.remote(), timeout=30)
+    assert peak <= 3, f"cap violated: peak={peak}"
+    assert peak >= 2, f"no concurrency at all: peak={peak}"
