@@ -130,24 +130,70 @@ def main():
         "step_time_ms": round(1000 * elapsed / steps, 2),
         "loss": round(final_loss, 4),
     }
+    # Free the 774M device state (params + Adam master/moments ≈ 8GB HBM)
+    # before the 1.5B and PPO sections — they need the chip to themselves.
+    import gc
+
+    del params, opt_state, metrics, tokens, batch_data
+    gc.collect()
     if on_tpu:
         try:
             result["gpt2_15b"] = bench_15b()
         except Exception as e:  # 1.5B must never break the 774M line
             result["gpt2_15b_error"] = repr(e)[:300]
+        gc.collect()
     try:
         result.update(bench_ppo(on_tpu))
     except Exception as e:  # PPO bench must never break the MFU line
         result["ppo_error"] = repr(e)[:200]
-    try:
-        result["core_microbench"] = bench_core()
-    except Exception as e:
-        result["core_microbench_error"] = repr(e)[:200]
-    try:
-        result["serve_bench"] = bench_serve()
-    except Exception as e:
-        result["serve_bench_error"] = repr(e)[:200]
+    # Host-plane benches (core runtime, serve) run in a FRESH CPU-only
+    # subprocess: the TPU-tunneled parent's resident device state and
+    # axon-attached workers would skew pure host numbers.
+    for key, fn_name in (("core_microbench", "bench_core"),
+                         ("serve_bench", "bench_serve")):
+        try:
+            result[key] = _run_host_bench_subprocess(fn_name)
+        except Exception as e:
+            result[key + "_error"] = repr(e)[:200]
     print(json.dumps(result))
+
+
+def _run_host_bench_subprocess(fn_name: str) -> dict:
+    import subprocess
+    import tempfile
+
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import json, sys\n"
+        "sys.path.insert(0, %r)\n"
+        "import bench\n"
+        "if __name__ == '__main__':\n"
+        "    print('RESULT::' + json.dumps(getattr(bench, %r)()))\n"
+        % (os.path.dirname(os.path.abspath(__file__)), fn_name)
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as f:
+        f.write(code)
+        script = f.name
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], capture_output=True, text=True,
+            timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    finally:
+        try:
+            os.unlink(script)
+        except OSError:
+            pass
+    for line in proc.stdout.splitlines():
+        if line.startswith("RESULT::"):
+            return json.loads(line[len("RESULT::"):])
+    raise RuntimeError(
+        f"{fn_name} subprocess failed rc={proc.returncode}: "
+        f"{proc.stderr[-400:]}")
 
 
 def bench_core() -> dict:
@@ -166,7 +212,13 @@ def bench_core() -> dict:
     out = {}
     for row in rows:
         key = row["name"].replace(" ", "_").replace(":", "_")
-        out[key] = row.get("GB_per_s", row["ops_per_s"])
+        if "GB_per_s" in row:
+            # Explicit units: a bare number here was misread as ops/s
+            # in round 2 (4.6 *GB/s* looked like 4.6 puts/s).
+            out[key + "_GBps"] = row["GB_per_s"]
+            out[key + "_ops_per_s"] = row["ops_per_s"]
+        else:
+            out[key] = row["ops_per_s"]
     return out
 
 

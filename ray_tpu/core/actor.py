@@ -164,6 +164,13 @@ class ActorClass:
         new._cls_blob = self._cls_blob
         return new
 
+    def bind(self, *args, **kwargs):
+        """DAG node builder (reference: cls.bind → ClassNode); defined
+        here so every process has it without importing ray_tpu.dag."""
+        from ..dag import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
     def _method_table(self) -> Dict[str, dict]:
         methods = {}
         for name, member in inspect.getmembers(self._cls):

@@ -97,6 +97,14 @@ class RemoteFunction:
         new._fn_blob = self._fn_blob
         return new
 
+    def bind(self, *args, **kwargs):
+        """DAG node builder (reference: fn.bind → FunctionNode). Defined
+        here so it works in ANY process (workers building continuations
+        included), not only ones that imported ray_tpu.dag first."""
+        from ..dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def _blob(self) -> bytes:
         if self._fn_blob is None:
             self._fn_blob = serialization.dumps(self._fn)

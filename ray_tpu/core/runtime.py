@@ -122,10 +122,19 @@ class Runtime:
         self._lineage_bytes = 0
         self._actors: Dict[ActorID, _ActorRecord] = {}
         self._refcounts: Dict[ObjectID, int] = {}
-        self._worker_tasks: Dict[bytes, TaskID] = {}  # worker_id -> running task
+        # worker_id -> TaskIDs assigned to it (1 running + pipelined
+        # same-key tasks queued in its pipe, scheduler.PIPELINE_DEPTH)
+        self._worker_tasks: Dict[bytes, set] = {}
         self._blocked_workers: Dict[bytes, NodeManager] = {}
         self._put_counter = 0
         self._env = dict(env or {})
+        self._stopped = threading.Event()
+        self._submit_buf: List[_TaskRecord] = []
+        self._submit_cv = threading.Condition()
+        self._submit_flusher = threading.Thread(
+            target=self._submit_flush_loop, daemon=True,
+            name="rt-submit-flush")
+        self._submit_flusher.start()
         # Before any worker starts: tracing on the driver + inherited by
         # every worker via env (config flag tracing_enabled).
         if config().tracing_enabled:
@@ -580,6 +589,8 @@ class Runtime:
         callback()
 
     def object_future(self, ref: ObjectRef) -> Future:
+        if self._submit_buf:
+            self._flush_submissions()
         fut: Future = Future()
         recover = False
         ready = False
@@ -649,6 +660,8 @@ class Runtime:
              timeout: Optional[float] = None, fetch_local: bool = True):
         if num_returns > len(refs):
             raise ValueError("num_returns exceeds number of refs")
+        if self._submit_buf:
+            self._flush_submissions()
         deadline = None if timeout is None else time.monotonic() + timeout
         done: set = set()
 
@@ -678,8 +691,12 @@ class Runtime:
     # ------------------------------------------------------ task submission
     def submit_spec(self, spec: TaskSpec) -> List[ObjectRef]:
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            self._flush_submissions()
             return self._create_actor(spec)
         if spec.task_type == TaskType.ACTOR_TASK:
+            # Actor pushes resolve args immediately: any buffered producer
+            # must reach the scheduler first.
+            self._flush_submissions()
             return self._submit_actor_task(spec)
         return self._submit_normal_task(spec)
 
@@ -693,8 +710,64 @@ class Runtime:
                 entry = self._objects.setdefault(oid, _ObjectEntry())
                 entry.creating_task = spec.task_id
         self._increment_arg_pins(spec)
-        self._schedule_task(record)
+        # Buffered submission (reference: the submitter batches lease
+        # requests per scheduling key): records enqueue into a small
+        # driver-side buffer and enter the scheduler in BULK — one lock
+        # round + one wake per batch instead of per task. Refs are valid
+        # immediately (entries exist above); get/wait flush the buffer.
+        with self._submit_cv:
+            self._submit_buf.append(record)
+            n = len(self._submit_buf)
+            self._submit_cv.notify()
+        if n >= 16:
+            self._flush_submissions()
         return return_refs
+
+    def _flush_submissions(self) -> None:
+        """Move buffered records into the scheduler in one bulk step."""
+        with self._submit_cv:
+            records, self._submit_buf = self._submit_buf, []
+        if not records:
+            return
+        leases = []
+        with self._lock:
+            for record in records:
+                spec = record.spec
+                lease = PendingLease(
+                    spec,
+                    on_granted=(lambda r: lambda node, worker:
+                                self._dispatch(r, node, worker))(record),
+                    on_unschedulable=(lambda r: lambda msg: self._fail_task(
+                        r, TaskError(RuntimeError(msg),
+                                     task_desc=r.spec.describe())))(record),
+                )
+                record.lease = lease
+                pending_deps = 0
+                for oid in spec.arg_refs:
+                    entry = self._objects.setdefault(oid, _ObjectEntry())
+                    if entry.status == _ObjStatus.PENDING:
+                        entry.waiting_tasks.append(spec.task_id)
+                        pending_deps += 1
+                    elif entry.status == _ObjStatus.LOST:
+                        entry.waiting_tasks.append(spec.task_id)
+                        pending_deps += 1
+                        self._recover_object(oid)
+                record.deps_remaining = pending_deps
+                lease.deps_ready = pending_deps == 0
+                leases.append(lease)
+        self.scheduler.submit_bulk(leases)
+
+    def _submit_flush_loop(self) -> None:
+        """Flushes the submission buffer shortly after it goes non-empty
+        (bounded latency for drivers that submit and then go quiet)."""
+        while not self._stopped.is_set():
+            with self._submit_cv:
+                while not self._submit_buf and not self._stopped.is_set():
+                    self._submit_cv.wait()
+                if self._stopped.is_set():
+                    return
+            time.sleep(0.001)  # let a burst accumulate
+            self._flush_submissions()
 
     def _retain_lineage(self, spec: TaskSpec) -> None:
         size = len(spec.args_frame) + len(spec.function_blob or b"")
@@ -756,10 +829,9 @@ class Runtime:
             record.node = node
             record.worker = worker
             record.state = "RUNNING"
-            self._worker_tasks[worker.worker_id.binary()] = spec.task_id
+            self._worker_tasks.setdefault(
+                worker.worker_id.binary(), set()).add(spec.task_id)
         if failed_error is not None:
-            node.pool.return_worker(worker)
-            self.scheduler.release(node, spec)
             self._fail_task(record, failed_error, retryable=False)
             return
         ok = worker.send(("exec", spec.task_id.hex(), {
@@ -784,9 +856,11 @@ class Runtime:
         spec = record.spec
         with self._lock:
             record.state = "DONE"
-            self._worker_tasks.pop(
-                record.worker.worker_id.binary() if record.worker else b"", None
-            )
+            if record.worker is not None:
+                assigned = self._worker_tasks.get(
+                    record.worker.worker_id.binary())
+                if assigned is not None:
+                    assigned.discard(spec.task_id)
         for i, (kind, payload) in enumerate(results):
             oid = ObjectID.for_return(spec.task_id, i)
             if kind == "inline":
@@ -802,22 +876,34 @@ class Runtime:
 
     def _release_after_task(self, record: _TaskRecord) -> None:
         node, worker, spec = record.node, record.worker, record.spec
-        if node is not None and worker is not None:
-            if spec.task_type != TaskType.ACTOR_TASK:
-                if record.resources_released:
-                    node.pool.return_worker(worker)
-                    return
-                # Worker-reuse fast path (OnWorkerIdle): dispatch the next
-                # compatible queued task to this worker directly from the
-                # completion handler, skipping a scheduler-thread wake.
-                lease = self.scheduler.reuse_or_return(node, worker, spec)
-                if lease is not None:
-                    try:
-                        lease.on_granted(node, worker)
-                    except Exception as e:  # pragma: no cover — defensive
-                        self.scheduler.release(node, lease.spec)
-                        node.pool.return_worker(worker)
-                        lease.on_unschedulable(str(e))
+        if node is None or worker is None:
+            return
+        if spec.task_type == TaskType.ACTOR_TASK:
+            return
+        if spec.strategy.kind != "DEFAULT" or \
+                spec.task_type != TaskType.NORMAL_TASK:
+            # Non-pipelined strategies keep per-task lease semantics.
+            node.pool.return_worker(worker)
+            if not record.resources_released:
+                self.scheduler.release(node, spec)
+            return
+        with self._lock:
+            assigned = self._worker_tasks.get(worker.worker_id.binary())
+            remaining = len(assigned) if assigned else 0
+        if record.resources_released:
+            # Blocked-worker path already gave the lease's resources back;
+            # tell the scheduler so the final release is skipped.
+            self.scheduler.release_lease_resources(node, worker, spec)
+        # Worker-reuse fast path (OnWorkerIdle): top the still-leased
+        # worker back up with same-key tasks straight from the completion
+        # handler; returns the worker when idle and nothing is claimable.
+        leases = self.scheduler.finish_on_worker(node, worker, spec,
+                                                 remaining)
+        for lease in leases:
+            try:
+                lease.on_granted(node, worker)
+            except Exception as e:  # pragma: no cover — defensive
+                lease.on_unschedulable(str(e))
 
     def _decrement_arg_pins(self, spec: TaskSpec) -> None:
         for oid in list(spec.arg_refs) + list(spec.borrowed_refs):
@@ -836,7 +922,10 @@ class Runtime:
         )
         with self._lock:
             if record.worker is not None:
-                self._worker_tasks.pop(record.worker.worker_id.binary(), None)
+                assigned = self._worker_tasks.get(
+                    record.worker.worker_id.binary())
+                if assigned is not None:
+                    assigned.discard(spec.task_id)
         if record.node is not None:
             self._release_after_task(record)
         if retry:
@@ -1002,7 +1091,8 @@ class Runtime:
                                       node=record.node, worker=record.worker,
                                       state="RUNNING")
             self._tasks[spec.task_id] = task_record
-            self._worker_tasks[record.worker.worker_id.binary()] = spec.task_id
+            self._worker_tasks.setdefault(
+                record.worker.worker_id.binary(), set()).add(spec.task_id)
         resolved: Dict[int, Any] = {}
         failed = None
         with self._lock:
@@ -1152,6 +1242,12 @@ class Runtime:
                 if actor is not None:
                     with self._lock:
                         actor.in_flight.pop(task_id.binary(), None)
+                with self._lock:
+                    if record.worker is not None:
+                        assigned = self._worker_tasks.get(
+                            record.worker.worker_id.binary())
+                        if assigned is not None:
+                            assigned.discard(task_id)
                 record.state = "FAILED"
                 for oid in record.spec.return_ids():
                     self._mark_failed(oid, error)
@@ -1200,6 +1296,11 @@ class Runtime:
         spec = record.spec
         with self._lock:
             record.state = "DONE"
+            if record.worker is not None:
+                assigned = self._worker_tasks.get(
+                    record.worker.worker_id.binary())
+                if assigned is not None:
+                    assigned.discard(spec.task_id)
         for i, (kind, payload) in enumerate(results):
             oid = ObjectID.for_return(spec.task_id, i)
             if kind == "inline":
@@ -1450,15 +1551,37 @@ class Runtime:
         when nested tasks wait on their children.
         """
         with self._lock:
-            task_id = self._worker_tasks.get(worker.worker_id.binary())
-            record = self._tasks.get(task_id) if task_id else None
+            assigned = self._worker_tasks.get(worker.worker_id.binary())
+            # Pipelined tasks share one same-key lease: any record stands
+            # in for the lease's resource shape.
+            record = None
+            for task_id in assigned or ():
+                r = self._tasks.get(task_id)
+                if r is not None and r.state == "RUNNING":
+                    record = r
+                    break
             node = self.scheduler.get_node(worker.node_id)
             if record is not None and node is not None and not record.resources_released:
-                record.resources_released = True
-                if record.spec.strategy.kind != "PLACEMENT_GROUP":
-                    node.ledger.release(record.spec.resources)
+                for task_id in assigned or ():
+                    r = self._tasks.get(task_id)
+                    if r is not None:
+                        r.resources_released = True
                 node.pool.grow(1)
                 self._blocked_workers[worker.worker_id.binary()] = node
+            else:
+                record = None
+        if record is not None:
+            if worker.actor_id is not None:
+                # Dedicated actor worker: no pool lease — free the CPU the
+                # blocked method logically holds so nested children can
+                # schedule (old per-record semantics).
+                if record.spec.strategy.kind != "PLACEMENT_GROUP":
+                    node.ledger.release(record.spec.resources)
+            else:
+                # Release the lease's resources ONCE (flagged on the
+                # handle so the completion path skips its final release).
+                self.scheduler.release_lease_resources(node, worker,
+                                                       record.spec)
         self.scheduler.notify()
 
     def _mark_worker_unblocked(self, worker: WorkerHandle) -> None:
@@ -1476,9 +1599,13 @@ class Runtime:
         victim = None
         with self._lock:
             for worker_bin in reversed(list(self._worker_tasks)):
-                task_id = self._worker_tasks[worker_bin]
-                record = self._tasks.get(task_id)
-                if (record is not None and record.state == "RUNNING"
+                record = None
+                for task_id in self._worker_tasks[worker_bin]:
+                    r = self._tasks.get(task_id)
+                    if r is not None and r.state == "RUNNING":
+                        record = r
+                        break
+                if (record is not None
                         and record.worker is not None
                         and record.worker.actor_id is None
                         and record.retries_left > 0):
@@ -1512,8 +1639,10 @@ class Runtime:
     # ------------------------------------------------------- worker death
     def _handle_worker_death(self, worker: WorkerHandle) -> None:
         with self._lock:
-            task_id = self._worker_tasks.pop(worker.worker_id.binary(), None)
-            record = self._tasks.get(task_id) if task_id else None
+            assigned = self._worker_tasks.pop(worker.worker_id.binary(),
+                                              None) or set()
+            records = [r for r in (self._tasks.get(t) for t in assigned)
+                       if r is not None]
             actor_record = None
             if worker.actor_id is not None:
                 actor_record = self._actors.get(worker.actor_id)
@@ -1523,9 +1652,12 @@ class Runtime:
         if actor_record is not None:
             self._handle_actor_death(actor_record)
             return
-        if record is not None and record.state == "RUNNING":
-            self._fail_task(record, WorkerCrashedError(
-                f"worker executing {record.spec.describe()} died"))
+        # Fail EVERY task assigned to the dead worker (1 running +
+        # pipelined ones queued in its pipe).
+        for record in records:
+            if record.state == "RUNNING":
+                self._fail_task(record, WorkerCrashedError(
+                    f"worker executing {record.spec.describe()} died"))
         self.scheduler.notify()
 
     def _handle_actor_death(self, record: _ActorRecord) -> None:
@@ -1608,6 +1740,9 @@ class Runtime:
 
     # ---------------------------------------------------------- shutdown
     def shutdown(self) -> None:
+        self._stopped.set()
+        with self._submit_cv:
+            self._submit_cv.notify_all()
         self.gcs.finish_job(self.job_id)
         install_refcount_hooks()
         self._hb_stop.set()

@@ -215,6 +215,14 @@ class ClusterScheduler:
             self._queue.append(lease)
             self._wake.notify_all()
 
+    def submit_bulk(self, leases: List[PendingLease]) -> None:
+        """One lock round + one wake for a whole submission batch."""
+        if not leases:
+            return
+        with self._lock:
+            self._queue.extend(leases)
+            self._wake.notify_all()
+
     def notify(self) -> None:
         with self._lock:
             self._wake.notify_all()
@@ -324,8 +332,21 @@ class ClusterScheduler:
                             continue
                     if lease.spec.strategy.kind != "PLACEMENT_GROUP":
                         node.ledger.acquire(lease.spec.resources)
+                    worker._lease_active = True
+                    worker._lease_released = False
                     granted.append((lease, node, worker))
                 self._queue = remaining
+                # Fill fresh leases to PIPELINE_DEPTH with same-key tasks
+                # (the worker executes them FIFO from its pipe; no extra
+                # resource acquisition — serial on the one lease).
+                for lease, node, worker in list(granted):
+                    spec = lease.spec
+                    if (spec.task_type == TaskType.NORMAL_TASK
+                            and spec.strategy.kind == "DEFAULT"):
+                        for extra in self._claim_same_key_locked(
+                                lease.scheduling_key,
+                                self.PIPELINE_DEPTH - 1):
+                            granted.append((extra, node, worker))
                 if not granted:
                     self._wake.wait(timeout=0.05)
             for lease, node, worker in granted:
@@ -351,39 +372,86 @@ class ClusterScheduler:
                 node.ledger.release(spec.resources)
             self._wake.notify_all()
 
-    def reuse_or_return(self, node: NodeManager, worker: WorkerHandle,
-                        finished_spec: TaskSpec) -> Optional[PendingLease]:
-        """Completion fast path (reference: ``OnWorkerIdle``,
-        ``direct_task_transport.h:135``): release the finished task's
-        resources and hand the still-leased worker the next compatible
-        queued lease directly, skipping the scheduler-thread round trip.
-        Returns the claimed lease (caller dispatches it on its own
-        thread) or None after returning the worker to the pool.
+    # Max tasks assigned to one leased worker at a time (1 running +
+    # depth-1 queued in its pipe). Reference: worker reuse while the
+    # lease is held, ``direct_task_transport.h:135`` OnWorkerIdle.
+    PIPELINE_DEPTH = 4
 
-        Only DEFAULT-strategy normal tasks are reused: SPREAD must
-        rotate nodes, PG/affinity tasks carry placement constraints, and
-        actor creation needs a dedicated worker.
+    def _claim_same_key_locked(self, key: tuple, max_n: int
+                               ) -> List[PendingLease]:
+        """Under self._lock: pop up to max_n deps-ready DEFAULT normal
+        leases with this scheduling key (no new resource acquisition —
+        the worker's held lease covers serial execution, as in the
+        reference where a leased worker keeps its resources across
+        same-key tasks)."""
+        out: List[PendingLease] = []
+        if max_n <= 0:
+            return out
+        i = 0
+        while i < len(self._queue) and len(out) < max_n:
+            lease = self._queue[i]
+            spec = lease.spec
+            if (lease.deps_ready
+                    and spec.task_type == TaskType.NORMAL_TASK
+                    and spec.strategy.kind == "DEFAULT"
+                    and lease.scheduling_key == key):
+                out.append(self._queue.pop(i))
+            else:
+                i += 1
+        return out
+
+    def finish_on_worker(self, node: NodeManager, worker: WorkerHandle,
+                         finished_spec: TaskSpec,
+                         remaining: int) -> List[PendingLease]:
+        """Completion fast path for DEFAULT normal tasks: keep the lease
+        hot by claiming more same-key tasks for this worker (returned
+        for the caller to dispatch on its own thread), or — when the
+        worker's assignment count drops to zero and nothing is claimable
+        — release the lease's resources and return the worker.
+
+        Only DEFAULT-strategy normal tasks pipeline: SPREAD must rotate
+        nodes, PG/affinity tasks carry placement constraints, actor
+        creation needs a dedicated worker.
         """
         with self._lock:
-            if finished_spec.strategy.kind != "PLACEMENT_GROUP":
-                node.ledger.release(finished_spec.resources)
+            key = PendingLease(finished_spec, None, None).scheduling_key
+            # A blocked worker's lease gave its resources back
+            # (_lease_released): claiming more tasks onto it would run
+            # them unaccounted — stop reuse and let it drain.
             reusable = (node.alive and worker.alive()
-                        and worker.state == WorkerHandle.LEASED)
+                        and worker.state == WorkerHandle.LEASED
+                        and not getattr(worker, "_lease_released", False))
+            claimed: List[PendingLease] = []
             if reusable:
-                for i, lease in enumerate(self._queue):
-                    spec = lease.spec
-                    if (not lease.deps_ready
-                            or spec.task_type != TaskType.NORMAL_TASK
-                            or spec.strategy.kind != "DEFAULT"):
-                        continue
-                    if not node.ledger.fits(spec.resources):
-                        continue
-                    node.ledger.acquire(spec.resources)
-                    del self._queue[i]
-                    return lease
-            node.pool.return_worker(worker)
+                claimed = self._claim_same_key_locked(
+                    key, self.PIPELINE_DEPTH - remaining)
+            if not claimed and remaining == 0:
+                # End of lease: release its one resource acquisition
+                # exactly once (_lease_active), unless the blocked-worker
+                # path already gave it back (_lease_released).
+                if getattr(worker, "_lease_active", False):
+                    worker._lease_active = False
+                    if not getattr(worker, "_lease_released", False) and \
+                            finished_spec.strategy.kind != \
+                            "PLACEMENT_GROUP":
+                        node.ledger.release(finished_spec.resources)
+                    worker._lease_released = False
+                node.pool.return_worker(worker)
+                self._wake.notify_all()
+            return claimed
+
+    def release_lease_resources(self, node: NodeManager,
+                                worker: WorkerHandle,
+                                spec: TaskSpec) -> None:
+        """Blocked-worker path: release the lease's resources early; the
+        final finish_on_worker sees _lease_released and skips."""
+        with self._lock:
+            if getattr(worker, "_lease_active", False) and \
+                    not getattr(worker, "_lease_released", False):
+                worker._lease_released = True
+                if spec.strategy.kind != "PLACEMENT_GROUP":
+                    node.ledger.release(spec.resources)
             self._wake.notify_all()
-            return None
 
     def shutdown(self) -> None:
         with self._lock:

@@ -165,19 +165,6 @@ def bind_class(actor_cls, *args, **kwargs) -> ClassNode:
     return ClassNode(actor_cls, args, kwargs)
 
 
-def _install_bind_methods() -> None:
-    """Give RemoteFunction/ActorClass a ``.bind`` (reference API shape)."""
-    from ..core.actor import ActorClass
-    from ..core.remote_function import RemoteFunction
-
-    def fn_bind(self, *args, **kwargs):
-        return FunctionNode(self, args, kwargs)
-
-    def cls_bind(self, *args, **kwargs):
-        return ClassNode(self, args, kwargs)
-
-    RemoteFunction.bind = fn_bind
-    ActorClass.bind = cls_bind
-
-
-_install_bind_methods()
+# ``.bind`` lives ON RemoteFunction/ActorClass themselves (reference API
+# shape) so it exists in every process — see core/remote_function.py and
+# core/actor.py.

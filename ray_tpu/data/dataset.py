@@ -208,9 +208,23 @@ class Dataset:
 
     # ---------------------------------------------------------- restructure
     def repartition(self, num_blocks: int) -> "Dataset":
-        """Reference: dataset.py:776 — all-to-all rebalance of rows."""
-        rows = self.take_all()
-        return from_items(rows, parallelism=num_blocks)
+        """Reference: dataset.py:776 — all-to-all rebalance of rows via a
+        split wave + merge wave of TASKS (no driver materialization)."""
+        num_blocks = max(1, num_blocks)
+        split_task = remote(_range_split_task)
+        merge_task = remote(_concat_blocks_task)
+        pieces = [
+            split_task.options(num_returns=num_blocks).remote(ref,
+                                                              num_blocks)
+            for ref in self._blocks
+        ]
+        if num_blocks == 1:
+            pieces = [[p] for p in pieces]
+        return Dataset([
+            merge_task.remote(*[pieces[i][j]
+                                for i in range(len(self._blocks))])
+            for j in range(num_blocks)
+        ])
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
         """Reference: dataset.py:806 — map-stage split + reduce-stage merge
@@ -236,14 +250,52 @@ class Dataset:
         return Dataset(new_blocks)
 
     def sort(self, key=None, descending: bool = False) -> "Dataset":
-        """Distributed sample-sort (reference: _internal/sort.py)."""
-        rows = self.take_all()
-        rows.sort(key=(lambda r: _key_of(r, key)), reverse=descending)
-        return from_items(rows, parallelism=len(self._blocks))
+        """Distributed SAMPLE-SORT (reference: ``_internal/sort.py``):
+        1. sample wave — tasks draw key samples per block (only samples
+           reach the driver);
+        2. boundaries — driver picks n-1 splitters from the samples;
+        3. partition wave — tasks range-partition each block;
+        4. sort wave — tasks merge + sort each range partition.
+        No full-block data ever lands on the driver."""
+        n = max(1, len(self._blocks))
+        if n == 1:
+            task = remote(_sort_block_task)
+            return Dataset([task.remote(self._blocks[0], key, descending)])
+        sample_task = remote(_sample_keys_task)
+        samples: List[Any] = []
+        for part in get([sample_task.remote(ref, key, 16)
+                         for ref in self._blocks]):
+            samples.extend(part)
+        samples.sort()
+        if not samples:
+            return Dataset(list(self._blocks))
+        bounds = [samples[(i * len(samples)) // n] for i in range(1, n)]
+        part_task = remote(_range_partition_task)
+        merge_task = remote(_merge_sorted_task)
+        pieces = [
+            part_task.options(num_returns=n).remote(
+                ref, key, bounds, descending)
+            for ref in self._blocks
+        ]
+        blocks = [
+            merge_task.remote(key, descending,
+                              *[pieces[i][j]
+                                for i in range(len(self._blocks))])
+            for j in range(n)
+        ]
+        if descending:
+            blocks.reverse()
+        return Dataset(blocks)
+
+    def _block_row_counts(self) -> List[int]:
+        task = remote(_count_rows_task)
+        return get([task.remote(ref) for ref in self._blocks])
 
     def split(self, n: int, *, equal: bool = True) -> List["Dataset"]:
         """Reference: dataset.py:918 — split into n datasets (per-rank
-        shards for train workers)."""
+        shards for train workers). The unequal-boundary path slices
+        blocks with TASKS by global row ranges — only per-block row
+        counts reach the driver."""
         if n <= 0:
             raise ValueError("n must be positive")
         if len(self._blocks) >= n and len(self._blocks) % n == 0:
@@ -252,9 +304,36 @@ class Dataset:
                 Dataset(self._blocks[i * per: (i + 1) * per])
                 for i in range(n)
             ]
-        rows = self.take_all()
-        shards = build_blocks(rows, n)
-        return [Dataset([put(s)]) for s in shards]
+        counts = self._block_row_counts()
+        total = sum(counts)
+        per = total // n
+        extra = total % n
+        slice_task = remote(_slice_rows_task)
+        shards: List[Dataset] = []
+        # Global row cursor walks blocks; each shard takes [start, end).
+        start = 0
+        block_starts = []
+        acc = 0
+        for c in counts:
+            block_starts.append(acc)
+            acc += c
+        for s in range(n):
+            length = per + (1 if s < extra else 0)
+            end = start + length
+            shard_blocks = []
+            for bi, c in enumerate(counts):
+                b0 = block_starts[bi]
+                b1 = b0 + c
+                lo, hi = max(start, b0), min(end, b1)
+                if lo < hi:
+                    if lo == b0 and hi == b1:
+                        shard_blocks.append(self._blocks[bi])
+                    else:
+                        shard_blocks.append(slice_task.remote(
+                            self._blocks[bi], lo - b0, hi - b0))
+            shards.append(Dataset(shard_blocks or [put([])]))
+            start = end
+        return shards
 
     def union(self, *others: "Dataset") -> "Dataset":
         blocks = list(self._blocks)
@@ -401,32 +480,152 @@ class Dataset:
 
 
 class GroupedData:
-    """Reference: grouped_dataset.py — groupby + aggregate."""
+    """Reference: grouped_dataset.py — groupby + aggregate, executed as a
+    HASH-PARTITION wave + per-partition aggregate TASKS (every group's
+    rows land whole in one partition; nothing materializes on the
+    driver)."""
 
     def __init__(self, ds: Dataset, key):
         self._ds = ds
         self._key = key
 
-    def _groups(self) -> Dict[Any, List[Any]]:
-        groups: Dict[Any, List[Any]] = {}
-        for row in self._ds.take_all():
-            groups.setdefault(_key_of(row, self._key), []).append(row)
-        return groups
+    def _partitions(self) -> List[Any]:
+        """Hash-partition block refs: partition j holds all rows whose
+        key hashes to j (groups never straddle partitions)."""
+        blocks = self._ds._blocks
+        n = max(1, len(blocks))
+        part_task = remote(_hash_partition_task)
+        merge_task = remote(_concat_blocks_task)
+        pieces = [
+            part_task.options(num_returns=n).remote(ref, self._key, n)
+            for ref in blocks
+        ]
+        if n == 1:
+            pieces = [[p] for p in pieces]
+        return [
+            merge_task.remote(*[pieces[i][j] for i in range(len(blocks))])
+            for j in range(n)
+        ]
 
     def count(self) -> Dataset:
-        rows = [{"key": k, "count": len(v)} for k, v in self._groups().items()]
-        return from_items(rows)
+        task = remote(_group_count_task)
+        return Dataset([task.remote(self._key, p)
+                        for p in self._partitions()])
 
     def aggregate(self, agg_fn: Callable[[List[Any]], Any]) -> Dataset:
-        rows = [{"key": k, "value": agg_fn(v)}
-                for k, v in self._groups().items()]
-        return from_items(rows)
+        task = remote(_group_aggregate_task)
+        return Dataset([task.remote(self._key, agg_fn, p)
+                        for p in self._partitions()])
 
     def map_groups(self, fn: Callable[[List[Any]], List[Any]]) -> Dataset:
-        out: List[Any] = []
-        for v in self._groups().values():
-            out.extend(fn(v))
-        return from_items(out)
+        task = remote(_group_map_task)
+        return Dataset([task.remote(self._key, fn, p)
+                        for p in self._partitions()])
+
+
+# -- distributed restructure task bodies -------------------------------------
+
+def _count_rows_task(block) -> int:
+    return BlockAccessor.for_block(block).num_rows()
+
+
+def _slice_rows_task(block, start: int, end: int):
+    acc = BlockAccessor.for_block(block)
+    return acc.slice(start, end)
+
+
+def _sort_block_task(block, key, descending):
+    rows = BlockAccessor.for_block(block).to_rows()
+    rows.sort(key=lambda r: _key_of(r, key), reverse=descending)
+    return rows
+
+
+def _sample_keys_task(block, key, k):
+    rows = BlockAccessor.for_block(block).to_rows()
+    if not rows:
+        return []
+    step = max(1, len(rows) // k)
+    return [_key_of(rows[i], key) for i in range(0, len(rows), step)][:k]
+
+
+def _range_partition_task(block, key, bounds, descending):
+    """Partition rows into len(bounds)+1 ascending key ranges."""
+    import bisect
+
+    n = len(bounds) + 1
+    parts: List[List[Any]] = [[] for _ in range(n)]
+    for row in BlockAccessor.for_block(block).to_rows():
+        parts[bisect.bisect_right(bounds, _key_of(row, key))].append(row)
+    return tuple(parts) if n > 1 else parts[0]
+
+
+def _merge_sorted_task(key, descending, *parts):
+    rows = []
+    for p in parts:
+        rows.extend(BlockAccessor.for_block(p).to_rows())
+    rows.sort(key=lambda r: _key_of(r, key), reverse=descending)
+    return rows
+
+
+def _range_split_task(block, n):
+    """Contiguous n-way split of one block's rows. Always returns
+    exactly n pieces (build_blocks caps at the row count, so short
+    blocks pad with empty pieces to honor num_returns=n)."""
+    rows = BlockAccessor.for_block(block).to_rows()
+    if n <= 1:
+        return rows
+    pieces = [list(p) for p in build_blocks(rows, n)]
+    while len(pieces) < n:
+        pieces.append([])
+    return tuple(pieces)
+
+
+def _concat_blocks_task(*parts):
+    rows = []
+    for p in parts:
+        rows.extend(BlockAccessor.for_block(p).to_rows())
+    return rows
+
+
+def _stable_hash(value) -> int:
+    """Process-independent hash (builtin ``hash`` is seed-randomized for
+    strings, which would scatter one group across partitions when tasks
+    run in different worker processes)."""
+    import zlib
+
+    return zlib.crc32(repr(value).encode("utf-8", "backslashreplace"))
+
+
+def _hash_partition_task(block, key, n):
+    parts: List[List[Any]] = [[] for _ in range(n)]
+    for row in BlockAccessor.for_block(block).to_rows():
+        parts[_stable_hash(_key_of(row, key)) % n].append(row)
+    return tuple(parts) if n > 1 else parts[0]
+
+
+def _group_count_task(key, part):
+    groups: Dict[Any, int] = {}
+    for row in BlockAccessor.for_block(part).to_rows():
+        k = _key_of(row, key)
+        groups[k] = groups.get(k, 0) + 1
+    return [{"key": k, "count": c} for k, c in groups.items()]
+
+
+def _group_aggregate_task(key, agg_fn, part):
+    groups: Dict[Any, List[Any]] = {}
+    for row in BlockAccessor.for_block(part).to_rows():
+        groups.setdefault(_key_of(row, key), []).append(row)
+    return [{"key": k, "value": agg_fn(v)} for k, v in groups.items()]
+
+
+def _group_map_task(key, fn, part):
+    groups: Dict[Any, List[Any]] = {}
+    for row in BlockAccessor.for_block(part).to_rows():
+        groups.setdefault(_key_of(row, key), []).append(row)
+    out: List[Any] = []
+    for v in groups.values():
+        out.extend(fn(v))
+    return out
 
 
 # -- shuffle task bodies -----------------------------------------------------

@@ -21,6 +21,83 @@ from .events import global_event_log
 from .metrics import registry
 
 
+def node_stats() -> dict:
+    """Per-node hardware stats (reference: the per-node dashboard AGENT's
+    reporter module, ``modules/reporter/reporter_agent.py`` — psutil
+    cpu/mem publisher; stdlib /proc reads here)."""
+    stats: dict = {}
+    try:
+        with open("/proc/loadavg") as f:
+            parts = f.read().split()
+        stats["loadavg_1m"] = float(parts[0])
+    except Exception:
+        pass
+    try:
+        mem = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, _, v = line.partition(":")
+                mem[k] = v.strip()
+        total_kb = int(mem["MemTotal"].split()[0])
+        avail_kb = int(mem["MemAvailable"].split()[0])
+        stats["mem_total_bytes"] = total_kb * 1024
+        stats["mem_available_bytes"] = avail_kb * 1024
+        stats["mem_used_frac"] = round(1 - avail_kb / total_kb, 4)
+    except Exception:
+        pass
+    try:
+        import os as _os
+
+        stats["num_cpus"] = _os.cpu_count()
+        stats["pid"] = _os.getpid()
+    except Exception:
+        pass
+    return stats
+
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title><style>
+body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+h1{font-size:1.2rem} h2{font-size:1rem;margin-top:1.2rem}
+table{border-collapse:collapse;font-size:.85rem;width:100%}
+td,th{border:1px solid #ddd;padding:.25rem .5rem;text-align:left}
+th{background:#eee} code{background:#eee;padding:0 .25rem}
+#err{color:#b00}
+</style></head><body>
+<h1>ray_tpu dashboard</h1>
+<div id="err"></div>
+<div id="sections"></div>
+<script>
+const APIS = ["summary","nodes","actors","tasks","workers",
+              "placement_groups","events"];
+function render(name, data){
+  const rows = Array.isArray(data) ? data :
+    Object.entries(data).map(([k,v])=>({key:k,value:JSON.stringify(v)}));
+  if(!rows.length) return `<h2>${name}</h2><p>(empty)</p>`;
+  const cols = Object.keys(rows[0]);
+  const head = cols.map(c=>`<th>${c}</th>`).join("");
+  const body = rows.slice(0,100).map(r=>"<tr>"+cols.map(
+    c=>`<td>${typeof r[c]==="object"?JSON.stringify(r[c]):r[c]}</td>`
+  ).join("")+"</tr>").join("");
+  return `<h2>${name} (${rows.length})</h2>
+          <table><tr>${head}</tr>${body}</table>`;
+}
+async function refresh(){
+  let html = "";
+  for(const api of APIS){
+    try{
+      const res = await fetch("/api/"+api);
+      html += render(api, await res.json());
+    }catch(e){
+      document.getElementById("err").textContent = String(e);
+    }
+  }
+  document.getElementById("sections").innerHTML = html;
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>"""
+
+
 class Dashboard:
     def __init__(self, host: str = "127.0.0.1", port: int = 8265):
         self.host = host
@@ -40,6 +117,7 @@ class Dashboard:
             "/api/placement_groups": state_api.list_placement_groups,
             "/api/summary": state_api.summarize_tasks,
             "/api/events": lambda: global_event_log().query(limit=200),
+            "/api/node_stats": node_stats,
         }
 
         class Handler(BaseHTTPRequestHandler):
@@ -48,6 +126,13 @@ class Dashboard:
 
             def do_GET(self):
                 path = self.path.split("?")[0]
+                if path in ("/", "/index.html"):
+                    body = _INDEX_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html")
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if path == "/healthz":
                     self.send_response(200)
                     self.end_headers()

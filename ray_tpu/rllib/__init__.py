@@ -6,9 +6,17 @@ RolloutWorker/WorkerSet, SampleBatch, env abstractions).
 """
 
 from .algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from .appo import APPO, APPOConfig
 from .dqn import DQN, DQNConfig
 from .env import AtariSim, FastCartPole, GymVectorEnv, VectorEnv, make_env
 from .impala import Impala, ImpalaConfig, vtrace
+from .multi_agent import MultiAgentEnv, make_multi_agent, sample_multi_agent
+from .offline import (
+    ImportanceSampling,
+    JsonReader,
+    JsonWriter,
+    WeightedImportanceSampling,
+)
 from .ondevice import JAX_ENVS, JaxEnv, OnDevicePPO, jax_atari_sim, \
     jax_cartpole
 from .policy import JaxPolicy, make_network
@@ -23,6 +31,15 @@ from .rollout_worker import RolloutWorker
 from .sample_batch import SampleBatch, compute_gae
 
 __all__ = [
+    "APPO",
+    "APPOConfig",
+    "MultiAgentEnv",
+    "make_multi_agent",
+    "sample_multi_agent",
+    "ImportanceSampling",
+    "JsonReader",
+    "JsonWriter",
+    "WeightedImportanceSampling",
     "Algorithm", "AlgorithmConfig", "AtariSim", "DQN", "DQNConfig",
     "FastCartPole", "GymVectorEnv", "Impala", "ImpalaConfig", "JAX_ENVS",
     "JaxEnv", "JaxPolicy", "MultiAgentReplayBuffer", "OnDevicePPO", "PPO",

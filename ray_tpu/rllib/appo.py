@@ -1,0 +1,87 @@
+"""APPO: asynchronous PPO — IMPALA's actor-learner pipeline with PPO's
+clipped surrogate objective over V-trace-corrected advantages.
+
+Reference analog: ``rllib/algorithms/appo/`` — APPO extends IMPALA
+(``appo.py`` subclasses Impala) replacing the plain policy-gradient term
+with the clipped surrogate so stale (lagged) rollouts can't push the
+policy arbitrarily far.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .impala import Impala, ImpalaConfig, vtrace
+from .policy import forward_mlp
+from .sample_batch import ACTIONS, DONES, LOGPS, OBS, REWARDS
+
+
+def appo_loss(params, batch, gamma, vf_coeff, ent_coeff, clip_param,
+              apply_fn=forward_mlp):
+    """IMPALA loss with the PPO clipped surrogate on V-trace advantages."""
+    obs = batch[OBS]
+    t_len, n = obs.shape[:2]
+    flat_obs = obs.reshape((t_len * n,) + obs.shape[2:])
+    logits, values = apply_fn(params, flat_obs)
+    logits = logits.reshape(t_len, n, -1)
+    values = values.reshape(t_len, n)
+    logp_all = jax.nn.log_softmax(logits)
+    actions = batch[ACTIONS].astype(jnp.int32)
+    target_logp = jnp.take_along_axis(
+        logp_all, actions[..., None], axis=-1)[..., 0]
+    _, bootstrap = apply_fn(params, batch["final_obs"])
+
+    vs, pg_adv = vtrace(batch[LOGPS], target_logp, batch[REWARDS],
+                        batch[DONES], values, bootstrap, gamma)
+    ratio = jnp.exp(target_logp - batch[LOGPS])
+    surr = jnp.minimum(
+        ratio * pg_adv,
+        jnp.clip(ratio, 1.0 - clip_param, 1.0 + clip_param) * pg_adv)
+    pg_loss = -jnp.mean(surr)
+    vf_loss = 0.5 * jnp.mean((values - vs) ** 2)
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    loss = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+    return loss, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                  "entropy": entropy}
+
+
+class APPOConfig(ImpalaConfig):
+    def __init__(self):
+        super().__init__()
+        self._algo_class = APPO
+        self.clip_param = 0.2
+
+    def training(self, **kwargs) -> "APPOConfig":
+        if "clip_param" in kwargs:
+            self.clip_param = kwargs.pop("clip_param")
+        super().training(**kwargs)
+        return self
+
+
+class APPO(Impala):
+    """Same async pipeline as Impala; only the jitted update differs."""
+
+    def setup(self, config: APPOConfig) -> None:
+        import optax
+
+        super().setup(config)
+        gamma = config.gamma
+        vf_coeff, ent_coeff = config.vf_coeff, config.entropy_coeff
+        clip_param = config.clip_param
+        apply_fn = self.workers.local_worker.policy.net.apply
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                appo_loss, has_aux=True)(
+                    params, batch, gamma, vf_coeff, ent_coeff,
+                    clip_param, apply_fn)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, metrics
+
+        self._update = update

@@ -1,0 +1,179 @@
+"""Offline RL IO + off-policy estimation.
+
+Reference analog: ``rllib/offline/`` — ``JsonWriter``/``JsonReader``
+persist SampleBatches as JSONL for offline training/evaluation, and the
+off-policy estimators (``offline/estimators/importance_sampling.py``,
+``weighted_importance_sampling.py``) score a target policy on behavior
+data without running it in the environment.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from .sample_batch import ACTIONS, DONES, LOGPS, OBS, REWARDS, SampleBatch
+
+
+class JsonWriter:
+    """Appends SampleBatches to JSONL files (reference: JsonWriter)."""
+
+    def __init__(self, path: str, max_file_size: int = 64 * 1024 * 1024):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._max = max_file_size
+        self._index = 0
+        self._file = None
+
+    def _ensure_file(self):
+        if self._file is None or self._file.tell() > self._max:
+            if self._file is not None:
+                self._file.close()
+            self._index += 1
+            self._file = open(os.path.join(
+                self.path, f"output-{self._index:05d}.jsonl"), "a")
+        return self._file
+
+    def write(self, batch: SampleBatch) -> None:
+        row = {k: np.asarray(v).tolist() for k, v in batch.items()}
+        dtypes = {k: str(np.asarray(v).dtype) for k, v in batch.items()}
+        f = self._ensure_file()
+        f.write(json.dumps({"columns": row, "dtypes": dtypes}) + "\n")
+        f.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class JsonReader:
+    """Reads SampleBatches back from a JsonWriter directory (reference:
+    JsonReader) — for offline training and off-policy evaluation."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def _files(self) -> List[str]:
+        if os.path.isfile(self.path):
+            return [self.path]
+        return sorted(glob.glob(os.path.join(self.path, "*.jsonl")))
+
+    def iter_batches(self) -> Iterator[SampleBatch]:
+        for file in self._files():
+            with open(file) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    entry = json.loads(line)
+                    cols = entry["columns"]
+                    dtypes = entry.get("dtypes", {})
+                    yield SampleBatch({
+                        k: np.asarray(v, dtype=dtypes.get(k))
+                        for k, v in cols.items()
+                    })
+
+    def read_all(self) -> SampleBatch:
+        batches = list(self.iter_batches())
+        if not batches:
+            raise ValueError(f"no batches under {self.path!r}")
+        return SampleBatch.concat_samples(batches)
+
+
+class OffPolicyEstimator:
+    """Scores a TARGET policy on BEHAVIOR data (reference:
+    ``offline/estimators/off_policy_estimator.py``).
+
+    ``target_logp_fn(obs, actions) -> logp`` gives the target policy's
+    log-prob of the logged actions; the batch's LOGPS column holds the
+    behavior policy's. Batches are episode fragments: DONES splits
+    episodes.
+    """
+
+    def __init__(self, target_logp_fn: Callable, gamma: float = 0.99):
+        self._logp = target_logp_fn
+        self.gamma = gamma
+
+    def _episodes(self, batch: SampleBatch):
+        """Split time-flat [T, ...] columns into per-episode slices
+        (DONES marks episode ends)."""
+        dones = np.asarray(batch[DONES]).reshape(-1)
+        bounds = list(np.nonzero(dones)[0] + 1)
+        if not bounds or bounds[-1] != len(dones):
+            bounds.append(len(dones))
+        start = 0
+        for end in bounds:
+            yield {k: np.asarray(v)[start:end] for k, v in batch.items()}
+            start = end
+
+    def _episode_terms(self, ep) -> Dict[str, float]:
+        rewards = ep[REWARDS].astype(np.float64)
+        discounts = self.gamma ** np.arange(len(rewards))
+        behavior_return = float(np.sum(discounts * rewards))
+        target_logp = np.asarray(self._logp(ep[OBS], ep[ACTIONS]),
+                                 np.float64)
+        log_ratio = np.cumsum(target_logp - ep[LOGPS].astype(np.float64))
+        weights = np.exp(np.clip(log_ratio, -30, 30))
+        return {
+            "behavior_return": behavior_return,
+            "per_step_weights": weights,
+            "discounted_rewards": discounts * rewards,
+        }
+
+    def estimate(self, batch: SampleBatch) -> Dict[str, float]:
+        raise NotImplementedError
+
+
+class ImportanceSampling(OffPolicyEstimator):
+    """Ordinary per-decision IS (reference:
+    ``offline/estimators/importance_sampling.py``): V_target =
+    mean over episodes of sum_t w_t * gamma^t * r_t."""
+
+    def estimate(self, batch: SampleBatch) -> Dict[str, float]:
+        v_b, v_t, n = 0.0, 0.0, 0
+        for ep in self._episodes(batch):
+            terms = self._episode_terms(ep)
+            v_b += terms["behavior_return"]
+            v_t += float(np.sum(terms["per_step_weights"]
+                                * terms["discounted_rewards"]))
+            n += 1
+        n = max(n, 1)
+        v_b, v_t = v_b / n, v_t / n
+        return {"v_behavior": v_b, "v_target": v_t,
+                "v_gain": v_t / v_b if v_b else float("nan")}
+
+
+class WeightedImportanceSampling(OffPolicyEstimator):
+    """WIS (reference: ``weighted_importance_sampling.py``): per-step
+    weights are normalized by their mean across episodes at each t —
+    biased but far lower variance than ordinary IS."""
+
+    def estimate(self, batch: SampleBatch) -> Dict[str, float]:
+        episodes = [self._episode_terms(ep)
+                    for ep in self._episodes(batch)]
+        if not episodes:
+            return {"v_behavior": 0.0, "v_target": 0.0,
+                    "v_gain": float("nan")}
+        max_t = max(len(e["per_step_weights"]) for e in episodes)
+        # Mean weight per timestep across episodes (0-padded).
+        sums = np.zeros(max_t)
+        counts = np.zeros(max_t)
+        for e in episodes:
+            w = e["per_step_weights"]
+            sums[:len(w)] += w
+            counts[:len(w)] += 1
+        mean_w = sums / np.maximum(counts, 1)
+        v_b = v_t = 0.0
+        for e in episodes:
+            w = e["per_step_weights"]
+            norm = w / np.maximum(mean_w[:len(w)], 1e-12)
+            v_b += e["behavior_return"]
+            v_t += float(np.sum(norm * e["discounted_rewards"]))
+        n = len(episodes)
+        v_b, v_t = v_b / n, v_t / n
+        return {"v_behavior": v_b, "v_target": v_t,
+                "v_gain": v_t / v_b if v_b else float("nan")}

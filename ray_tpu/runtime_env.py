@@ -21,17 +21,21 @@ from typing import Any, Dict, List, Optional
 class RuntimeEnv(dict):
     """Validated runtime environment description."""
 
-    KNOWN = {"env_vars", "working_dir", "py_modules", "pip", "conda"}
+    KNOWN = {"env_vars", "working_dir", "py_modules", "pip", "conda",
+             "pip_wheel_dir"}
 
     def __init__(self, env_vars: Optional[Dict[str, str]] = None,
                  working_dir: Optional[str] = None,
                  py_modules: Optional[List[str]] = None,
                  pip: Optional[List[str]] = None,
-                 conda: Optional[Any] = None, **kwargs):
+                 conda: Optional[Any] = None,
+                 pip_wheel_dir: Optional[str] = None, **kwargs):
         unknown = set(kwargs) - self.KNOWN
         if unknown:
             raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
         super().__init__()
+        if pip_wheel_dir:
+            self["pip_wheel_dir"] = os.path.abspath(pip_wheel_dir)
         if env_vars:
             if not all(isinstance(k, str) and isinstance(v, str)
                        for k, v in env_vars.items()):
@@ -86,16 +90,90 @@ def apply_runtime_env(env: Optional[Dict]) -> Dict[str, Any]:
                   if os.path.isfile(mod_path) else mod_path)
         sys.path.insert(0, parent)
         undo.setdefault("extra_paths", []).append(parent)
-    for pkg in env.get("pip", []):
-        name = pkg.split("==")[0].split(">=")[0].replace("-", "_")
-        try:
-            __import__(name)
-        except ImportError as e:
-            raise RuntimeError(
-                f"runtime_env pip package {pkg!r} unavailable and installs "
-                f"are disabled in this environment"
-            ) from e
+    pip_pkgs = env.get("pip") or []
+    if pip_pkgs:
+        wheel_dir = env.get("pip_wheel_dir") or os.environ.get(
+            "RT_RUNTIME_ENV_WHEEL_DIR")
+        if wheel_dir:
+            site = materialize_pip_env(pip_pkgs, wheel_dir)
+            sys.path.insert(0, site)
+            undo.setdefault("extra_paths", []).append(site)
+        else:
+            # NETWORK installs are forbidden here: without a local wheel
+            # dir the packages must already import.
+            for pkg in pip_pkgs:
+                name = pkg.split("==")[0].split(">=")[0].replace("-", "_")
+                try:
+                    __import__(name)
+                except ImportError as e:
+                    raise RuntimeError(
+                        f"runtime_env pip package {pkg!r} unavailable; "
+                        f"installs are disabled — provide pip_wheel_dir "
+                        f"(or RT_RUNTIME_ENV_WHEEL_DIR) with local wheels"
+                    ) from e
     return undo
+
+
+def materialize_pip_env(pip: List[str], wheel_dir: str) -> str:
+    """Per-env-hash package materialization with caching (reference:
+    ``_private/runtime_env/pip.py`` builds a venv per env hash; here a
+    ``pip install --no-index --find-links=<local wheels> --target=<cache>``
+    gives the same isolation contract fully OFFLINE). Concurrent workers
+    race on a directory lock; the winner installs, the rest reuse."""
+    import hashlib
+    import json as json_mod
+    import subprocess
+    import time as time_mod
+
+    key = hashlib.sha1(json_mod.dumps(
+        [sorted(pip), os.path.abspath(wheel_dir)]).encode()).hexdigest()[:16]
+    target = os.path.join(tempfile.gettempdir(), "rt_runtime_env", "pip",
+                          key)
+    marker = os.path.join(target, ".rt_ready")
+    if os.path.exists(marker):
+        return target
+    lock_dir = target + ".lock"
+    os.makedirs(os.path.dirname(target), exist_ok=True)
+    deadline = time_mod.monotonic() + 120
+    while True:
+        try:
+            os.mkdir(lock_dir)
+            break
+        except FileExistsError:
+            if os.path.exists(marker):
+                return target
+            # Stale-lock recovery: the holder may have been killed mid
+            # install (worker OOM kill, host crash) — steal locks older
+            # than 300s; the new winner re-runs the install over any
+            # partial target (pip --target overwrites safely).
+            try:
+                if time_mod.time() - os.path.getmtime(lock_dir) > 300:
+                    os.rmdir(lock_dir)
+                    continue
+            except OSError:
+                continue  # raced with the holder's cleanup
+            if time_mod.monotonic() > deadline:
+                raise TimeoutError(f"pip env lock stuck: {lock_dir}")
+            time_mod.sleep(0.2)
+    try:
+        if not os.path.exists(marker):
+            subprocess.run(
+                [sys.executable, "-m", "pip", "install", "--quiet",
+                 "--no-index", "--find-links", wheel_dir,
+                 "--target", target] + list(pip),
+                check=True, capture_output=True, timeout=300)
+            with open(marker, "w") as f:
+                f.write("ok")
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"offline pip install failed for {pip}: "
+            f"{e.stderr.decode(errors='replace')[:500]}") from e
+    finally:
+        try:
+            os.rmdir(lock_dir)
+        except OSError:
+            pass
+    return target
 
 
 def restore_runtime_env(undo: Dict[str, Any]) -> None:

@@ -7,11 +7,15 @@ surface (``python/ray/air``).
 from . import session
 from .checkpoint import Checkpoint, CheckpointManager, restore_arrays, save_arrays
 from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .predictor import BatchPredictor, JaxPredictor, Predictor
 from .step import build_sharded_train, default_optimizer, make_eval_step
 from .trainer import BackendExecutor, DataParallelTrainer, JaxTrainer, Result
 from .worker_group import WorkerGroup
 
 __all__ = [
+    "BatchPredictor",
+    "JaxPredictor",
+    "Predictor",
     "BackendExecutor", "Checkpoint", "CheckpointConfig", "CheckpointManager",
     "DataParallelTrainer", "FailureConfig", "JaxTrainer", "Result",
     "RunConfig", "ScalingConfig", "WorkerGroup", "build_sharded_train",

@@ -139,6 +139,8 @@ class CheckpointManager:
         self.score_attribute = score_attribute
         self.score_order = score_order
         self._entries = []  # (step, score, path)
+        self._executor = None
+        self._pending = []
 
     def save(self, checkpoint: Checkpoint, step: int,
              metrics: Optional[Dict] = None) -> str:
@@ -150,6 +152,46 @@ class CheckpointManager:
         self._entries.append((step, score, path))
         self._enforce_retention()
         return path
+
+    def save_async(self, checkpoint: Checkpoint, step: int,
+                   metrics: Optional[Dict] = None):
+        """Orbax-style ASYNC save (SURVEY §7.2 stage 6): the device→host
+        snapshot happens NOW — consistent with this training step even if
+        the next step donates/overwrites the buffers — while pickling and
+        disk IO run on a background thread. Returns a Future of the
+        checkpoint path; ``wait_async()`` joins all pending saves."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        data = checkpoint.to_dict()
+        try:
+            import jax
+            import numpy as np
+
+            def snap(x):
+                if isinstance(x, np.ndarray):
+                    return x.copy()  # caller may mutate in the next step
+                if hasattr(x, "devices") or hasattr(x, "device_buffer"):
+                    return np.asarray(jax.device_get(x))
+                return x
+
+            data = jax.tree.map(snap, data)
+        except Exception:
+            pass
+        host_ckpt = Checkpoint.from_dict(data)
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="rt-ckpt-save")
+        fut = self._executor.submit(self.save, host_ckpt, step, metrics)
+        self._pending.append(fut)
+        return fut
+
+    def wait_async(self, timeout: Optional[float] = None) -> None:
+        """Block until every async save has landed on disk."""
+        from concurrent.futures import wait as _wait
+
+        pending, self._pending = self._pending, []
+        if pending:
+            _wait(pending, timeout=timeout)
 
     def latest(self) -> Optional[Checkpoint]:
         if not self._entries:

@@ -56,6 +56,9 @@ class CheckpointConfig:
     checkpoint_score_order: str = "max"
     checkpoint_frequency: int = 0
     checkpoint_at_end: bool = True
+    # Orbax-style async save: snapshot now, disk IO off the training
+    # thread (the trainer joins pending saves before returning).
+    async_save: bool = False
 
 
 @dataclass

@@ -155,7 +155,10 @@ class DataParallelTrainer:
             history.append(metrics)
             if ckpt is not None:
                 step_counter[0] += 1
-                manager.save(ckpt, step_counter[0], metrics)
+                if ckpt_cfg.async_save:
+                    manager.save_async(ckpt, step_counter[0], metrics)
+                else:
+                    manager.save(ckpt, step_counter[0], metrics)
                 latest_ckpt[0] = ckpt
 
         executor = BackendExecutor(self.scaling_config)
@@ -178,6 +181,7 @@ class DataParallelTrainer:
                 if failures_left != 0:
                     failures_left -= 1
                     continue  # restart from latest checkpoint
+                manager.wait_async()
                 return Result(metrics=history[-1] if history else {},
                               checkpoint=latest_ckpt[0], error=str(e),
                               metrics_history=history, path=trial_dir)
@@ -186,6 +190,7 @@ class DataParallelTrainer:
             if errors and failures_left != 0:
                 failures_left -= 1
                 continue
+            manager.wait_async()  # async checkpoint saves land before done
             return Result(
                 metrics=history[-1] if history else {},
                 checkpoint=latest_ckpt[0],

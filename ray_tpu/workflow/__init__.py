@@ -92,6 +92,103 @@ def _step_key(node: DAGNode, index: int) -> str:
     return f"{index:04d}_{type(node).__name__}"
 
 
+def options(node: DAGNode, *, max_retries: int = 0,
+            retry_delay_s: float = 0.1,
+            timeout_s: Optional[float] = None,
+            catch_exceptions: bool = False) -> DAGNode:
+    """Attach per-step workflow options (reference:
+    ``fn.options(**workflow.options(max_retries=..., catch_exceptions=
+    ...))``): retries with delay, a step timeout, and exception
+    capture — with ``catch_exceptions`` the step's persisted result is
+    ``(value, None)`` on success / ``(None, exception)`` on failure."""
+    node._wf_options = {
+        "max_retries": max_retries, "retry_delay_s": retry_delay_s,
+        "timeout_s": timeout_s, "catch_exceptions": catch_exceptions,
+    }
+    return node
+
+
+class EventListener:
+    """Reference: ``workflow/event_listener.py`` — poll_for_event blocks
+    until the external event arrives; the event STEP persists its result
+    like any step, so a resumed workflow does not re-wait."""
+
+    def poll_for_event(self) -> Any:
+        raise NotImplementedError
+
+
+class _EventNode(DAGNode):
+    def __init__(self, listener_factory, args, kwargs):
+        super().__init__((), {})
+        self._factory = listener_factory
+        self._args = args
+        self._kwargs = kwargs
+
+    def _execute_one(self, resolved, input_value):
+        listener = self._factory(*self._args, **self._kwargs)
+        return listener.poll_for_event()
+
+
+def wait_for_event(listener_factory, *args, **kwargs) -> DAGNode:
+    """A DAG step that blocks on an external event (checkpointed)."""
+    return _EventNode(listener_factory, args, kwargs)
+
+
+def _log_event(storage: "WorkflowStorage", kind: str, **fields) -> None:
+    path = os.path.join(storage.dir, "events.jsonl")
+    entry = {"ts": time.time(), "event": kind, **fields}
+    with open(path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def get_events(workflow_id: str) -> List[Dict]:
+    """The workflow's structured event log (step lifecycle + retries)."""
+    path = os.path.join(WorkflowStorage(workflow_id).dir, "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _run_step(storage, key, node, resolved, input_value):
+    """One step with retries/timeout/catch_exceptions + continuation:
+    a step RETURNING a DAGNode continues into that sub-workflow
+    (reference: ``workflow.continuation`` + workflow_executor.py:32)."""
+    opts = getattr(node, "_wf_options", None) or {}
+    retries_left = int(opts.get("max_retries", 0))
+    timeout_s = opts.get("timeout_s")
+    catch = bool(opts.get("catch_exceptions", False))
+    attempt = 0
+    while True:
+        attempt += 1
+        _log_event(storage, "step_started", step=key, attempt=attempt)
+        try:
+            ref_or_val = node._execute_one(resolved, input_value)
+            if hasattr(ref_or_val, "id"):
+                value = get(ref_or_val, timeout=timeout_s)
+            else:
+                value = ref_or_val
+            if isinstance(value, DAGNode):
+                # Continuation: execute the returned DAG as a nested
+                # sub-workflow rooted under this step's storage.
+                sub_id = f"{storage.workflow_id}/sub_{key}"
+                WorkflowStorage(sub_id).save_dag(value, input_value)
+                value = _execute_workflow(sub_id, value, input_value)
+            _log_event(storage, "step_finished", step=key,
+                       attempt=attempt)
+            return (value, None) if catch else value
+        except Exception as e:  # noqa: BLE001
+            _log_event(storage, "step_failed", step=key, attempt=attempt,
+                       error=repr(e)[:200])
+            if retries_left > 0:
+                retries_left -= 1
+                time.sleep(float(opts.get("retry_delay_s", 0.1)))
+                continue
+            if catch:
+                return (None, e)
+            raise
+
+
 def _execute_workflow(workflow_id: str, dag: DAGNode, input_value: Any):
     """Walk the DAG, skipping steps whose results are already persisted.
 
@@ -111,8 +208,7 @@ def _execute_workflow(workflow_id: str, dag: DAGNode, input_value: Any):
             if hit:
                 resolved[node._uuid] = cached
                 continue
-            ref_or_val = node._execute_one(resolved, input_value)
-            value = get(ref_or_val) if hasattr(ref_or_val, "id") else ref_or_val
+            value = _run_step(storage, key, node, resolved, input_value)
             storage.save_step(key, value)
             resolved[node._uuid] = value
         result = resolved[dag._uuid]

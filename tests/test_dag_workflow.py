@@ -143,3 +143,74 @@ def test_workflow_list(rt_shared, tmp_path):
     rows = workflow.list_all()
     assert any(r["workflow_id"] == "wf-1" and r["status"] == "SUCCESSFUL"
                for r in rows)
+
+
+def test_workflow_step_retries_and_catch(rt_init, tmp_path):
+    """Per-step options: max_retries re-runs flaky steps; catch_exceptions
+    converts failures to (None, exc) results (reference: workflow step
+    options + api)."""
+    import ray_tpu as rt
+    from ray_tpu import workflow
+
+    workflow.init(str(tmp_path / "wf"))
+    marker = tmp_path / "attempts.txt"
+
+    @rt.remote
+    def flaky(x):
+        with open(marker, "a") as f:
+            f.write("x")
+        n = len(open(marker).read())
+        if n < 3:
+            raise RuntimeError(f"attempt {n} fails")
+        return x * 10
+
+    node = workflow.options(flaky.bind(7), max_retries=5,
+                            retry_delay_s=0.01)
+    assert workflow.run(node, workflow_id="wf-retry") == 70
+    events = workflow.get_events("wf-retry")
+    kinds = [e["event"] for e in events]
+    assert kinds.count("step_failed") == 2
+    assert "step_finished" in kinds
+
+    @rt.remote
+    def always_boom():
+        raise ValueError("nope")
+
+    caught = workflow.options(always_boom.bind(), catch_exceptions=True)
+    value, err = workflow.run(caught, workflow_id="wf-catch")
+    assert value is None and isinstance(err, Exception)
+    assert workflow.get_status("wf-catch") == "SUCCESSFUL"
+
+
+def test_workflow_continuation_and_event(rt_init, tmp_path):
+    """A step returning a DAG continues into it (sub-workflow), and
+    wait_for_event steps persist their event payload."""
+    import ray_tpu as rt
+    from ray_tpu import workflow
+
+    workflow.init(str(tmp_path / "wf2"))
+
+    @rt.remote
+    def double(x):
+        return 2 * x
+
+    @rt.remote
+    def make_continuation(x):
+        # Returns a DAG: the workflow continues into double(x + 1).
+        return double.bind(x + 1)
+
+    out = workflow.run(make_continuation.bind(10), workflow_id="wf-cont")
+    assert out == 22
+
+    class Ready(workflow.EventListener):
+        def __init__(self, payload):
+            self._payload = payload
+
+        def poll_for_event(self):
+            return {"event_payload": self._payload}
+
+    ev = workflow.wait_for_event(Ready, "go")
+    result = workflow.run(ev, workflow_id="wf-event")
+    assert result == {"event_payload": "go"}
+    # Resume must NOT re-wait: the persisted event result is reused.
+    assert workflow.resume("wf-event") == {"event_payload": "go"}

@@ -29,7 +29,6 @@ def _wait_line(proc, timeout=120):
     raise TimeoutError("no JSON line from CLI process")
 
 
-@pytest.mark.timeout(600)
 def test_rt_start_assembles_two_node_cluster():
     """Head + one worker host started as separate CLI subprocesses; a
     driver connects through the client server and runs tasks that land

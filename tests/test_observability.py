@@ -108,3 +108,26 @@ def test_timeline_spans(tmp_path):
     path = timeline(str(tmp_path / "tl.json"))
     data = json.load(open(path))
     assert any(e["name"] == "task:f" and e["dur"] == 500000.0 for e in data)
+
+
+def test_dashboard_frontend_and_node_stats(rt_init):
+    """The dashboard serves an HTML frontend at / and per-node hardware
+    stats (reference: dashboard/client frontend + reporter agent)."""
+    import json
+    import urllib.request
+
+    from ray_tpu.observability.dashboard import Dashboard
+
+    dash = Dashboard(port=18341).start()
+    try:
+        with urllib.request.urlopen(
+                "http://127.0.0.1:18341/", timeout=30) as resp:
+            body = resp.read().decode()
+        assert "<html" in body and "ray_tpu dashboard" in body
+        with urllib.request.urlopen(
+                "http://127.0.0.1:18341/api/node_stats", timeout=30) as r:
+            stats = json.loads(r.read())
+        assert stats.get("mem_total_bytes", 0) > 0
+        assert "loadavg_1m" in stats
+    finally:
+        dash.stop()

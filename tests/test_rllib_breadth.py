@@ -1,0 +1,120 @@
+"""RLlib breadth: APPO, offline IO + off-policy estimators, multi-agent
+envs (reference: rllib/algorithms/appo, rllib/offline + estimators,
+rllib/env/multi_agent_env.py)."""
+
+import numpy as np
+import pytest
+
+
+def test_appo_iteration_and_improvement(rt_shared):
+    from ray_tpu.rllib import APPOConfig
+
+    algo = (APPOConfig()
+            .environment("FastCartPole")
+            .rollouts(num_rollout_workers=0, num_envs_per_worker=4,
+                      rollout_fragment_length=32)
+            .training(lr=5e-3, num_batches_per_iter=4)
+            .build())
+    try:
+        r1 = algo.train()
+        assert r1["timesteps_this_iter"] > 0
+        assert np.isfinite(r1["loss"])
+        for _ in range(4):
+            r = algo.train()
+        assert np.isfinite(r["loss"])
+    finally:
+        algo.stop()
+
+
+def test_offline_json_roundtrip_and_estimators(tmp_path):
+    from ray_tpu.rllib import (
+        ImportanceSampling,
+        JsonReader,
+        JsonWriter,
+        SampleBatch,
+        WeightedImportanceSampling,
+    )
+    from ray_tpu.rllib.sample_batch import (
+        ACTIONS,
+        DONES,
+        LOGPS,
+        OBS,
+        REWARDS,
+    )
+
+    rng = np.random.default_rng(0)
+    T = 30
+    batch = SampleBatch({
+        OBS: rng.normal(size=(T, 4)).astype(np.float32),
+        ACTIONS: rng.integers(0, 2, T).astype(np.int32),
+        REWARDS: np.ones(T, np.float32),
+        DONES: np.asarray([(t % 10) == 9 for t in range(T)]),
+        LOGPS: np.full(T, np.log(0.5), np.float32),  # uniform behavior
+    })
+    writer = JsonWriter(str(tmp_path / "out"))
+    writer.write(batch)
+    writer.close()
+    back = JsonReader(str(tmp_path / "out")).read_all()
+    np.testing.assert_allclose(back[OBS], batch[OBS], rtol=1e-6)
+    assert back[ACTIONS].dtype == np.int32
+
+    # Target policy == behavior policy -> IS and WIS both estimate the
+    # behavior return exactly (all importance weights are 1).
+    same = lambda obs, acts: np.full(len(acts), np.log(0.5))
+    for cls in (ImportanceSampling, WeightedImportanceSampling):
+        est = cls(same, gamma=0.9).estimate(back)
+        np.testing.assert_allclose(est["v_target"], est["v_behavior"],
+                                   rtol=1e-6)
+
+    # A target policy MORE likely to take the logged actions scores
+    # higher under ordinary IS (weights > 1 on every step).
+    better = lambda obs, acts: np.full(len(acts), np.log(0.8))
+    est = ImportanceSampling(better, gamma=0.9).estimate(back)
+    assert est["v_target"] > est["v_behavior"]
+    # WIS normalizes the uniform-weight inflation away entirely.
+    wis = WeightedImportanceSampling(better, gamma=0.9).estimate(back)
+    np.testing.assert_allclose(wis["v_target"], wis["v_behavior"],
+                               rtol=1e-6)
+
+
+class _TwoArmBandit:
+    """1-step env: action 1 pays 1.0, action 0 pays 0."""
+
+    def reset(self, seed=None):
+        return np.zeros(2, np.float32)
+
+    def step(self, action):
+        return np.zeros(2, np.float32), float(action == 1), True, {}
+
+
+def test_multi_agent_env_and_sampling():
+    from ray_tpu.rllib import make_multi_agent, sample_multi_agent
+    from ray_tpu.rllib.sample_batch import ACTIONS, OBS, REWARDS
+
+    env_cls = make_multi_agent(_TwoArmBandit, num_agents=4)
+    env = env_cls()
+    obs = env.reset(seed=0)
+    assert set(obs) == {f"agent_{i}" for i in range(4)}
+
+    class _FixedPolicy:
+        def __init__(self, action):
+            self._a = action
+
+        def compute_actions(self, obs_batch, deterministic=False):
+            n = len(obs_batch)
+            return (np.full(n, self._a, np.int32),
+                    np.zeros(n, np.float32), np.zeros(n, np.float32))
+
+    policies = {"good": _FixedPolicy(1), "bad": _FixedPolicy(0)}
+
+    def mapping(aid):
+        return "good" if aid in ("agent_0", "agent_1") else "bad"
+
+    batches = sample_multi_agent(env_cls(), policies, mapping,
+                                 num_steps=6)
+    assert set(batches) == {"good", "bad"}
+    # 2 agents x 6 episodes (1-step env, auto-reset) per policy.
+    assert batches["good"][OBS].shape[0] == 12
+    assert float(batches["good"][REWARDS].sum()) == 12.0
+    assert float(batches["bad"][REWARDS].sum()) == 0.0
+    assert set(np.unique(batches["good"][ACTIONS])) == {1}

@@ -1,0 +1,83 @@
+"""Runtime-env pip isolation: offline per-env-hash materialization from
+a local wheel dir (reference: ``_private/runtime_env/pip.py`` venv per
+env hash; network installs are forbidden in this environment, so the
+build is --no-index over local wheels)."""
+
+import base64
+import hashlib
+import os
+import zipfile
+
+import pytest
+
+
+def _make_wheel(path: str, pkg: str, version: str, source: str) -> str:
+    """Hand-roll a minimal PEP-427 wheel (a zip with dist-info)."""
+    name = f"{pkg}-{version}-py3-none-any.whl"
+    dist = f"{pkg}-{version}.dist-info"
+    wheel_path = os.path.join(path, name)
+    records = []
+
+    def add(zf, arcname, data: bytes):
+        zf.writestr(arcname, data)
+        digest = base64.urlsafe_b64encode(
+            hashlib.sha256(data).digest()).rstrip(b"=").decode()
+        records.append(f"{arcname},sha256={digest},{len(data)}")
+
+    with zipfile.ZipFile(wheel_path, "w") as zf:
+        add(zf, f"{pkg}.py", source.encode())
+        add(zf, f"{dist}/METADATA",
+            f"Metadata-Version: 2.1\nName: {pkg}\nVersion: {version}\n"
+            .encode())
+        add(zf, f"{dist}/WHEEL",
+            b"Wheel-Version: 1.0\nGenerator: rt-test\nRoot-Is-Purelib: "
+            b"true\nTag: py3-none-any\n")
+        records.append(f"{dist}/RECORD,,")
+        zf.writestr(f"{dist}/RECORD", "\n".join(records) + "\n")
+    return wheel_path
+
+
+def test_materialize_pip_env_offline(tmp_path):
+    from ray_tpu.runtime_env import materialize_pip_env
+
+    wheels = tmp_path / "wheels"
+    wheels.mkdir()
+    _make_wheel(str(wheels), "rt_test_pkg", "1.0.0",
+                "MAGIC = 'from-local-wheel'\n")
+    site = materialize_pip_env(["rt_test_pkg"], str(wheels))
+    assert os.path.exists(os.path.join(site, "rt_test_pkg.py"))
+    # Cached: second call returns the same materialized dir instantly.
+    assert materialize_pip_env(["rt_test_pkg"], str(wheels)) == site
+
+
+def test_task_runs_in_pip_runtime_env(rt_init, tmp_path):
+    """A task with runtime_env={'pip': [...], 'pip_wheel_dir': ...} can
+    import the wheel-only package; tasks WITHOUT the env cannot."""
+    import ray_tpu as rt
+
+    wheels = tmp_path / "wheels"
+    wheels.mkdir()
+    _make_wheel(str(wheels), "rt_env_only_pkg", "2.0.0",
+                "VALUE = 41 + 1\n")
+
+    @rt.remote
+    def uses_pkg():
+        import rt_env_only_pkg
+
+        return rt_env_only_pkg.VALUE
+
+    env = {"pip": ["rt_env_only_pkg"], "pip_wheel_dir": str(wheels)}
+    assert rt.get(uses_pkg.options(runtime_env=env).remote(),
+                  timeout=120) == 42
+
+    # Isolation contract is PATH-level (like py_modules): tasks without
+    # the env never see the materialized site dir on sys.path. (A
+    # module-cache hit in a reused worker is possible, as in any shared
+    # worker pool, so asserting an ImportError would be flaky.)
+    @rt.remote
+    def sees_env_path():
+        import sys as _sys
+
+        return any("rt_runtime_env" in p for p in _sys.path)
+
+    assert rt.get(sees_env_path.remote(), timeout=60) is False
