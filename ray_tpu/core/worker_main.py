@@ -165,6 +165,31 @@ class WorkerRuntime:
                                 fut.set_result(value)
                             else:
                                 fut.set_exception(value)
+                    elif kind == "revoke":
+                        # Owner recall of queued-but-unstarted tasks
+                        # (sent while this worker blocks in get/wait):
+                        # pull matching execs out of the local queue so
+                        # the scheduler can run them on another worker
+                        # instead of starving them behind the blocked
+                        # head-of-line task. Races benignly with the
+                        # exec loop: a task it already popped is simply
+                        # not revoked.
+                        _, wanted = msg
+                        wanted = set(wanted)
+                        kept, revoked = [], []
+                        while True:
+                            try:
+                                q = self._task_queue.get_nowait()
+                            except queue.Empty:
+                                break
+                            if (q is not None and q[0] == "exec"
+                                    and q[1] in wanted):
+                                revoked.append(q[1])
+                            else:
+                                kept.append(q)
+                        for q in kept:
+                            self._task_queue.put(q)
+                        self._send(("revoked", revoked))
                     elif kind == "exit":
                         self._shutdown.set()
                         self._task_queue.put(None)
@@ -447,10 +472,75 @@ def get_worker_runtime() -> Optional[WorkerRuntime]:
     return _worker_runtime
 
 
+def _pin_jax_platform(platform: str) -> None:
+    """Force jax_platforms=<platform> in THIS process, whenever jax lands.
+
+    If a site hook already imported jax (the axon TPU tunnel does, in
+    every process), re-apply the override now; otherwise install a
+    meta-path hook that applies it the moment jax finishes importing —
+    zero cost for workers that never touch jax. A failed override is
+    loud (stderr), never silent: a worker on the wrong backend is the
+    round-3 multichip regression.
+    """
+    mod = sys.modules.get("jax")
+    if mod is not None:
+        try:
+            mod.config.update("jax_platforms", platform)
+        except Exception as e:  # noqa: BLE001 — diagnose, don't crash
+            print(f"ray_tpu worker: RT_JAX_PLATFORM={platform!r} could "
+                  f"not be applied: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+        return
+
+    import importlib.abc
+    import importlib.util
+
+    class _PinFinder(importlib.abc.MetaPathFinder):
+        _busy = False
+
+        def find_spec(self, fullname, path=None, target=None):
+            if fullname != "jax" or _PinFinder._busy:
+                return None
+            _PinFinder._busy = True
+            try:
+                spec = importlib.util.find_spec("jax")
+            finally:
+                _PinFinder._busy = False
+            if spec is None or spec.loader is None:
+                return None
+            orig_exec = spec.loader.exec_module
+
+            def exec_module(module):
+                orig_exec(module)
+                sys.meta_path[:] = [
+                    f for f in sys.meta_path if f is not finder]
+                try:
+                    module.config.update("jax_platforms", platform)
+                except Exception as e:  # noqa: BLE001
+                    print(f"ray_tpu worker: RT_JAX_PLATFORM={platform!r} "
+                          f"could not be applied: "
+                          f"{type(e).__name__}: {e}", file=sys.stderr)
+
+            spec.loader.exec_module = exec_module
+            return spec
+
+    finder = _PinFinder()
+    sys.meta_path.insert(0, finder)
+
+
 def worker_entry(conn, worker_id_hex: str, node_id_hex: str, env: dict) -> None:
     """Child-process entrypoint (spawned by the worker pool)."""
     global _worker_runtime
     os.environ.update(env or {})
+    # RT_JAX_PLATFORM pins the worker's JAX backend BEFORE anything in
+    # user code initializes one. A plain JAX_PLATFORMS env var is not
+    # enough on hosts whose site hooks force a platform via
+    # jax.config.update at interpreter start (process-local, so the
+    # driver's own config.update never reaches spawned workers) — this
+    # re-applies the override after those hooks ran.
+    _plat = os.environ.get("RT_JAX_PLATFORM")
+    if _plat:
+        _pin_jax_platform(_plat)
     # Make this process identifiable in `ps` (reference: setproctitle).
     sys.argv[0] = f"rt::worker::{worker_id_hex[:8]}"
     from .log_monitor import redirect_worker_streams

@@ -12,6 +12,11 @@ import os
 # overwrite rather than setdefault — tests always run on the virtual
 # 8-device CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Env var alone is NOT enough for worker subprocesses on hosts whose site
+# hooks force a platform via jax.config.update at interpreter start (the
+# axon TPU tunnel does) — worker_main re-applies RT_JAX_PLATFORM after
+# those hooks, keeping every test worker on the virtual CPU mesh.
+os.environ["RT_JAX_PLATFORM"] = "cpu"
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (
