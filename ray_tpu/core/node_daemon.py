@@ -166,8 +166,15 @@ class NodeDaemon:
             from .ids import ObjectID
 
             try:
-                buf = self.store.get_buffer(ObjectID(oid_bin))
-                payload = bytes(buf)
+                # Pinned: get_buffer drops the arena pin before
+                # returning, so a concurrent spill/delete could reuse
+                # the extent mid-copy.
+                buf = self.store.get_pinned(ObjectID(oid_bin))
+                try:
+                    payload = bytes(buf)
+                finally:
+                    buf.release()
+                    del buf
                 for frame in chunk_frames("chunk", req_id, payload):
                     self.conn.send(frame)
             except Exception as e:  # noqa: BLE001
@@ -268,21 +275,30 @@ class ObjectServer:
                     continue
                 _, req_id, oid_bin = msg
                 try:
-                    buf = self._store.get_buffer(ObjectID(oid_bin))
+                    # Pinned view: get_buffer releases the arena pin
+                    # before returning, so a concurrent spill/delete
+                    # could free and reuse the extent mid-stream and we
+                    # would ship corrupted bytes. The pin (deferred-free)
+                    # holds the extent until `buf` is dropped below.
+                    buf = self._store.get_pinned(ObjectID(oid_bin))
                 except Exception as e:  # noqa: BLE001 — lost/evicted
                     conn.send(("pull_err", req_id, repr(e)))
                     continue
                 # Stream straight off the zero-copy store view: only one
                 # CHUNK_SIZE copy is live at a time (no full-object copy).
-                total = max(1, -(-len(buf) // CHUNK_SIZE))
-                ok = True
-                for seq in range(total):
-                    data = bytes(
-                        buf[seq * CHUNK_SIZE:(seq + 1) * CHUNK_SIZE])
-                    if not conn.send(
-                            ("pull_chunk", req_id, seq, total, data)):
-                        ok = False
-                        break
+                try:
+                    total = max(1, -(-len(buf) // CHUNK_SIZE))
+                    ok = True
+                    for seq in range(total):
+                        data = bytes(
+                            buf[seq * CHUNK_SIZE:(seq + 1) * CHUNK_SIZE])
+                        if not conn.send(
+                                ("pull_chunk", req_id, seq, total, data)):
+                            ok = False
+                            break
+                finally:
+                    buf.release()
+                    del buf
                 if not ok:
                     return
         except (EOFError, OSError):
@@ -374,8 +390,14 @@ class PullManager:
         from .ids import ObjectID
 
         # Local store may already hold it (raced with a task result).
+        # Pinned copy: an unpinned view could be spilled/reused mid-read.
         try:
-            return bytes(self._daemon.store.get_buffer(ObjectID(oid_bin)))
+            buf = self._daemon.store.get_pinned(ObjectID(oid_bin))
+            try:
+                return bytes(buf)
+            finally:
+                buf.release()
+                del buf
         except Exception:
             pass
         loc = self._daemon.locate_object(oid_bin)

@@ -366,7 +366,7 @@ class Runtime:
                     node = self.scheduler.get_node(node_id)
                     if node is None:
                         raise ObjectLostError(oid, "holding node gone")
-                    return bytes(node.store.get_buffer(oid))
+                    return self._store_read_bytes(node.store, oid)
                 except ObjectLostError:
                     with self._lock:
                         entry.status = _ObjStatus.LOST
@@ -408,7 +408,7 @@ class Runtime:
                     # Holder is the head-local NodeManager (no object
                     # server): ship the frame inline.
                     payload = ("inline",
-                               bytes(holder.store.get_buffer(oid)))
+                               self._store_read_bytes(holder.store, oid))
                 else:
                     payload = ("shm", holder_id.hex(), size, addr)
             node.conn.send(("locate_reply", req_id, True, payload))
@@ -623,6 +623,22 @@ class Runtime:
         if recover:
             self._recover_object(ref.id)
         return fut
+
+    @staticmethod
+    def _store_read_bytes(store, oid: ObjectID) -> bytes:
+        """Private copy of a stored object's bytes. Pins local arenas for
+        the duration of the copy (get_buffer drops the pin before
+        returning, so a concurrent spill/delete could reuse the extent
+        mid-read); daemon-proxy stores already return a private copy."""
+        get_pinned = getattr(store, "get_pinned", None)
+        if get_pinned is None:
+            return bytes(store.get_buffer(oid))
+        buf = get_pinned(oid)
+        try:
+            return bytes(buf)
+        finally:
+            buf.release()
+            del buf
 
     def _materialize_value(self, oid: ObjectID):
         entry = self._objects[oid]
@@ -1193,6 +1209,13 @@ class Runtime:
         kind = msg[0]
         if kind == "register":
             return
+        if kind == "revoked":
+            # Reply to the revoke we sent when this worker blocked:
+            # these tasks were still queued (never started) in the
+            # worker's pipe — reschedule them so they can't starve
+            # behind the blocked head-of-line task.
+            self._requeue_revoked(worker, msg[1])
+            return
         if kind == "refadd":
             self._ref_added(ObjectID(msg[1]))
             return
@@ -1582,7 +1605,51 @@ class Runtime:
                 # handle so the completion path skips its final release).
                 self.scheduler.release_lease_resources(node, worker,
                                                        record.spec)
+                # Recall pipelined same-key tasks still queued in this
+                # worker's pipe: the head-of-line task may block
+                # indefinitely (e.g. on a signal or a borrowed ref), and
+                # eagerly-pushed tasks would starve even with idle
+                # workers. The worker replies "revoked" with the subset
+                # it actually pulled back (never-started by definition),
+                # which _requeue_revoked reschedules.
+                with self._lock:
+                    assigned = self._worker_tasks.get(
+                        worker.worker_id.binary()) or set()
+                    extra = [
+                        t.hex() for t in assigned
+                        if (r := self._tasks.get(t)) is not None
+                        and r.spec.task_type == TaskType.NORMAL_TASK
+                        and r.spec.strategy.kind == "DEFAULT"
+                    ]
+                if len(extra) > 1:
+                    worker.send(("revoke", extra))
         self.scheduler.notify()
+
+    def _requeue_revoked(self, worker: WorkerHandle, task_hexes) -> None:
+        """Reschedule tasks the worker pulled back out of its pipe. The
+        worker guarantees a revoked task never started; guard against
+        stale replies (worker death already rescheduled the record)."""
+        requeue = []
+        with self._lock:
+            assigned = self._worker_tasks.get(worker.worker_id.binary())
+            for task_hex in task_hexes:
+                task_id = TaskID.from_hex(task_hex)
+                record = self._tasks.get(task_id)
+                if (record is None or record.worker is not worker
+                        or record.state != "RUNNING"):
+                    continue
+                if assigned is not None:
+                    assigned.discard(task_id)
+                record.node = record.worker = None
+                record.state = "PENDING"
+                # The shared lease's resources were released on block;
+                # the fresh lease below does its own accounting.
+                record.resources_released = False
+                requeue.append(record)
+        for record in requeue:
+            self._schedule_task(record)
+        if requeue:
+            self.scheduler.notify()
 
     def _mark_worker_unblocked(self, worker: WorkerHandle) -> None:
         with self._lock:

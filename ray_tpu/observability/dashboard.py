@@ -70,16 +70,22 @@ th{background:#eee} code{background:#eee;padding:0 .25rem}
 <script>
 const APIS = ["summary","nodes","actors","tasks","workers",
               "placement_groups","events"];
+function esc(v){
+  // API values include user-controlled strings (task/actor names, event
+  // messages) — escape before interpolating into innerHTML (stored XSS).
+  return String(v).replace(/[&<>"']/g, ch => ({"&":"&amp;","<":"&lt;",
+    ">":"&gt;",'"':"&quot;","'":"&#39;"}[ch]));
+}
 function render(name, data){
   const rows = Array.isArray(data) ? data :
     Object.entries(data).map(([k,v])=>({key:k,value:JSON.stringify(v)}));
-  if(!rows.length) return `<h2>${name}</h2><p>(empty)</p>`;
+  if(!rows.length) return `<h2>${esc(name)}</h2><p>(empty)</p>`;
   const cols = Object.keys(rows[0]);
-  const head = cols.map(c=>`<th>${c}</th>`).join("");
+  const head = cols.map(c=>`<th>${esc(c)}</th>`).join("");
   const body = rows.slice(0,100).map(r=>"<tr>"+cols.map(
-    c=>`<td>${typeof r[c]==="object"?JSON.stringify(r[c]):r[c]}</td>`
+    c=>`<td>${esc(typeof r[c]==="object"?JSON.stringify(r[c]):r[c])}</td>`
   ).join("")+"</tr>").join("");
-  return `<h2>${name} (${rows.length})</h2>
+  return `<h2>${esc(name)} (${rows.length})</h2>
           <table><tr>${head}</tr>${body}</table>`;
 }
 async function refresh(){

@@ -130,6 +130,11 @@ class _Replica:
                 pass
 
     async def handle_request(self, args, kwargs):
+        # Sweep abandoned streams from the request path too: a replica
+        # whose LAST streaming consumer disconnected would otherwise
+        # leak that generator until another streaming request arrives.
+        if self._streams:
+            self._sweep_streams()
         self._ongoing += 1
         self._total += 1
         try:
@@ -154,6 +159,8 @@ class _Replica:
         (done, items). The stream is dropped when exhausted."""
         import inspect
 
+        if self._streams:
+            self._sweep_streams()
         entry = self._streams.get(stream_id)
         if entry is None:
             return True, []

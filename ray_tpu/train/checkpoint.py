@@ -165,6 +165,19 @@ class CheckpointManager:
         data = checkpoint.to_dict()
         try:
             import jax
+        except ImportError:
+            # No jax: plain dicts/numpy only; snapshot numpy leaves so
+            # the consistent-at-call-time guarantee still holds.
+            import numpy as np
+
+            data = {
+                k: (v.copy() if isinstance(v, np.ndarray) else v)
+                for k, v in data.items()
+            }
+        else:
+            # A real snapshot failure must propagate: silently writing
+            # the un-snapshotted dict in the background while the caller
+            # mutates params would corrupt the checkpoint.
             import numpy as np
 
             def snap(x):
@@ -175,8 +188,6 @@ class CheckpointManager:
                 return x
 
             data = jax.tree.map(snap, data)
-        except Exception:
-            pass
         host_ckpt = Checkpoint.from_dict(data)
         if self._executor is None:
             self._executor = ThreadPoolExecutor(
