@@ -16,6 +16,7 @@ trial's checkpoint (reference: pbt.py _exploit :607).
 
 from __future__ import annotations
 
+import os
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -137,13 +138,17 @@ class ResultGrid:
 class TrialRunner:
     """The experiment step loop (trial_runner.py:864)."""
 
+    STATE_FILE = "experiment_state.pkl"
+
     def __init__(self, trainable: Callable, searcher: Searcher,
                  scheduler: Optional[TrialScheduler] = None,
                  max_concurrent: int = 4,
                  max_failures: int = 0,
                  stop: Optional[Dict[str, Any]] = None,
                  resources_per_trial: Optional[Dict[str, float]] = None,
-                 poll_interval: float = 0.05):
+                 poll_interval: float = 0.05,
+                 experiment_path: Optional[str] = None,
+                 checkpoint_period: float = 1.0):
         self.trainable = trainable
         self.searcher = searcher
         self.scheduler = scheduler or FIFOScheduler()
@@ -153,7 +158,75 @@ class TrialRunner:
         self.resources = resources_per_trial or {"CPU": 1.0}
         self.poll_interval = poll_interval
         self.trials: List[Trial] = []
+        self.experiment_path = experiment_path
+        # Min seconds between experiment-state writes: pickling every
+        # trial's full results at poll frequency would dominate the loop
+        # (reference: trial_runner checkpoint_period, default ~10s).
+        self.checkpoint_period = checkpoint_period
+        self._dirty = False
+        self._last_save = 0.0
         self._actor_cls = remote(_TrialActor)
+
+    # -- experiment-level checkpointing --------------------------------------
+    # Reference: trial_runner.py:682 ``checkpoint`` — the runner persists
+    # its full state (trial table, searcher, scheduler) so a crashed sweep
+    # resumes with completed trials intact (``Tuner.restore``,
+    # tuner.py:159).
+    def save_state(self) -> None:
+        if not self.experiment_path:
+            return
+        import cloudpickle
+
+        os.makedirs(self.experiment_path, exist_ok=True)
+        # Live actor handles are per-process; strip them for the dump and
+        # put them back (single-threaded runner loop — no races). One
+        # blob keeps trial references shared by scheduler rungs / PBT
+        # state consistent on load.
+        stash = [(t, t.actor, t.done_ref) for t in self.trials]
+        for t in self.trials:
+            t.actor = None
+            t.done_ref = None
+        try:
+            blob = cloudpickle.dumps({
+                "trials": self.trials,
+                "searcher": self.searcher,
+                "scheduler": self.scheduler,
+                "trainable": self.trainable,
+                "stop": self.stop_criteria,
+                "max_concurrent": self.max_concurrent,
+                "max_failures": self.max_failures,
+                "resources": self.resources,
+            })
+        finally:
+            for t, actor, done_ref in stash:
+                t.actor = actor
+                t.done_ref = done_ref
+        tmp = os.path.join(self.experiment_path, self.STATE_FILE + ".tmp")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, os.path.join(self.experiment_path, self.STATE_FILE))
+        self._dirty = False
+        self._last_save = time.monotonic()
+
+    @classmethod
+    def load_state(cls, experiment_path: str) -> Dict:
+        import cloudpickle
+
+        with open(os.path.join(experiment_path, cls.STATE_FILE), "rb") as f:
+            return cloudpickle.loads(f.read())
+
+    def restore_from(self, state: Dict) -> None:
+        """Adopt a saved experiment state: completed trials keep their
+        results; trials that were RUNNING at save time become PENDING
+        and relaunch from their last in-trial checkpoint."""
+        self.trials = state["trials"]
+        self.searcher = state["searcher"]
+        self.scheduler = state["scheduler"]
+        for t in self.trials:
+            t.actor = None
+            t.done_ref = None
+            if t.status == TrialStatus.RUNNING:
+                t.status = TrialStatus.PENDING
 
     # -- lifecycle -----------------------------------------------------------
     def _launch(self, trial: Trial,
@@ -172,6 +245,7 @@ class TrialRunner:
 
     def _stop_trial(self, trial: Trial, status: str) -> None:
         trial.status = status
+        self._dirty = True
         if trial.actor is not None:
             try:
                 kill(trial.actor)
@@ -189,7 +263,12 @@ class TrialRunner:
                 break
             for trial in running:
                 self._poll_trial(trial)
+            if self._dirty and (time.monotonic() - self._last_save
+                                >= self.checkpoint_period):
+                self.save_state()
             time.sleep(self.poll_interval)
+        if self._dirty:
+            self.save_state()
         return ResultGrid(self.trials)
 
     def _more_trials_possible(self) -> bool:
@@ -205,6 +284,15 @@ class TrialRunner:
     def _maybe_start_trials(self) -> None:
         running = sum(1 for t in self.trials
                       if t.status == TrialStatus.RUNNING)
+        # Restored PENDING trials first (resume from their checkpoint)
+        # before consuming fresh samples from the searcher.
+        for trial in self.trials:
+            if running >= self.max_concurrent:
+                return
+            if trial.status == TrialStatus.PENDING and trial.actor is None:
+                self._launch(trial, checkpoint=trial.checkpoint)
+                self._dirty = True
+                running += 1
         while running < self.max_concurrent:
             trial_id = f"trial_{len(self.trials):05d}_{uuid.uuid4().hex[:6]}"
             config = self.searcher.suggest(trial_id)
@@ -213,6 +301,7 @@ class TrialRunner:
             trial = Trial(trial_id, config)
             self.trials.append(trial)
             self._launch(trial)
+            self._dirty = True
             running += 1
 
     def _poll_trial(self, trial: Trial) -> None:
@@ -222,6 +311,8 @@ class TrialRunner:
             self._handle_failure(trial, str(e))
             return
         decision = TrialDecision.CONTINUE
+        if reports:
+            self._dirty = True
         for metrics, ckpt in reports:
             trial.iteration += 1
             metrics.setdefault("training_iteration", trial.iteration)
@@ -310,6 +401,45 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
         self.resources_per_trial = resources_per_trial
+        self._restored_state: Optional[Dict] = None
+        self._restored_path: Optional[str] = None
+
+    def _experiment_path(self) -> Optional[str]:
+        if self._restored_path:
+            return self._restored_path
+        if self.run_config.storage_path is None:
+            return None
+        return os.path.join(self.run_config.storage_path,
+                            self.run_config.name or "tune_experiment")
+
+    @classmethod
+    def restore(cls, path: str,
+                trainable: Optional[Callable] = None) -> "Tuner":
+        """Resume a crashed/interrupted experiment from its persisted
+        state: completed trials keep their results (never retrained),
+        in-flight trials resume from their last in-trial checkpoint,
+        and searcher/scheduler state (consumed samples, ASHA rungs, PBT
+        history) carries over. Reference: ``tune/tuner.py:159``
+        ``Tuner.restore`` + experiment checkpointing
+        (``tune/execution/trial_runner.py:682``)."""
+        state = TrialRunner.load_state(path)
+        tuner = cls(
+            trainable or state["trainable"],
+            tune_config=TuneConfig(
+                max_concurrent_trials=state["max_concurrent"]),
+            run_config=RunConfig(
+                stop=state["stop"],
+                failure_config=FailureConfig(
+                    max_failures=state["max_failures"])),
+            resources_per_trial=state["resources"],
+        )
+        tuner._restored_state = state
+        tuner._restored_path = path
+        return tuner
+
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        return os.path.exists(os.path.join(path, TrialRunner.STATE_FILE))
 
     def fit(self) -> ResultGrid:
         from ..core import runtime as runtime_mod
@@ -325,7 +455,10 @@ class Tuner:
             max_failures=self.run_config.failure_config.max_failures,
             stop=self.run_config.stop,
             resources_per_trial=self.resources_per_trial,
+            experiment_path=self._experiment_path(),
         )
+        if self._restored_state is not None:
+            runner.restore_from(self._restored_state)
         return runner.run()
 
 
