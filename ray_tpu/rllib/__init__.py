@@ -8,7 +8,14 @@ RolloutWorker/WorkerSet, SampleBatch, env abstractions).
 from .algorithm import Algorithm, AlgorithmConfig, WorkerSet
 from .appo import APPO, APPOConfig
 from .dqn import DQN, DQNConfig
-from .env import AtariSim, FastCartPole, GymVectorEnv, VectorEnv, make_env
+from .env import (
+    AtariSim,
+    FastCartPole,
+    FastPendulum,
+    GymVectorEnv,
+    VectorEnv,
+    make_env,
+)
 from .impala import Impala, ImpalaConfig, vtrace
 from .multi_agent import MultiAgentEnv, make_multi_agent, sample_multi_agent
 from .offline import (
@@ -28,6 +35,7 @@ from .replay_buffers import (
     ReservoirReplayBuffer,
 )
 from .rollout_worker import RolloutWorker
+from .sac import SAC, SACConfig
 from .sample_batch import SampleBatch, compute_gae
 
 __all__ = [
@@ -41,7 +49,8 @@ __all__ = [
     "JsonWriter",
     "WeightedImportanceSampling",
     "Algorithm", "AlgorithmConfig", "AtariSim", "DQN", "DQNConfig",
-    "FastCartPole", "GymVectorEnv", "Impala", "ImpalaConfig", "JAX_ENVS",
+    "FastCartPole", "FastPendulum", "GymVectorEnv", "Impala",
+    "ImpalaConfig", "JAX_ENVS", "SAC", "SACConfig",
     "JaxEnv", "JaxPolicy", "MultiAgentReplayBuffer", "OnDevicePPO", "PPO",
     "PPOConfig", "PrioritizedReplayBuffer", "ReplayBuffer",
     "ReservoirReplayBuffer", "RolloutWorker", "SampleBatch", "VectorEnv",

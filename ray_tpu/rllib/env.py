@@ -132,6 +132,68 @@ class FastCartPole(VectorEnv):
         return self._state.copy(), rewards, done, {}
 
 
+class FastPendulum(VectorEnv):
+    """Vectorized numpy Pendulum-v1 (identical dynamics/reward) — the
+    continuous-action counterpart of FastCartPole; one batched numpy
+    update per step for all N envs. Continuous envs expose
+    ``action_dim`` + ``action_low/high`` instead of ``num_actions``."""
+
+    G = 10.0
+    M = 1.0
+    L = 1.0
+    DT = 0.05
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    MAX_STEPS = 200
+
+    num_actions = 0  # continuous
+    action_dim = 1
+    action_low = -2.0
+    action_high = 2.0
+
+    def __init__(self, num_envs: int = 1, seed: int = 0):
+        self.num_envs = num_envs
+        self.observation_space_shape = (3,)
+        self._rng = np.random.default_rng(seed)
+        self._theta = np.zeros(num_envs, np.float32)
+        self._thetadot = np.zeros(num_envs, np.float32)
+        self._steps = np.zeros(num_envs, np.int32)
+
+    def _obs(self) -> np.ndarray:
+        return np.stack([np.cos(self._theta), np.sin(self._theta),
+                         self._thetadot], axis=1).astype(np.float32)
+
+    def _reset_some(self, mask: np.ndarray) -> None:
+        n = int(mask.sum())
+        if n:
+            self._theta[mask] = self._rng.uniform(-np.pi, np.pi, n)
+            self._thetadot[mask] = self._rng.uniform(-1.0, 1.0, n)
+            self._steps[mask] = 0
+
+    def vector_reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._reset_some(np.ones(self.num_envs, bool))
+        return self._obs()
+
+    def vector_step(self, actions):
+        u = np.clip(np.asarray(actions, np.float32).reshape(self.num_envs),
+                    -self.MAX_TORQUE, self.MAX_TORQUE)
+        th, thdot = self._theta, self._thetadot
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        costs = norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        newthdot = thdot + (
+            3.0 * self.G / (2.0 * self.L) * np.sin(th)
+            + 3.0 / (self.M * self.L ** 2) * u) * self.DT
+        newthdot = np.clip(newthdot, -self.MAX_SPEED, self.MAX_SPEED)
+        self._theta = (th + newthdot * self.DT).astype(np.float32)
+        self._thetadot = newthdot.astype(np.float32)
+        self._steps += 1
+        done = self._steps >= self.MAX_STEPS
+        self._reset_some(done)
+        return (self._obs(), (-costs).astype(np.float32), done, {})
+
+
 class AtariSim(VectorEnv):
     """Synthetic Atari-SHAPED env: 84x84x4 uint8 frame-stack observations,
     6 actions, pong-like ball/paddle dynamics rendered with vectorized
@@ -220,6 +282,8 @@ def make_env(env: Any, num_envs: int, seed: int = 0) -> VectorEnv:
         raise TypeError("env factory must return a VectorEnv")
     if env == "FastCartPole":
         return FastCartPole(num_envs, seed)
+    if env == "FastPendulum":
+        return FastPendulum(num_envs, seed)
     if env == "AtariSim":
         return AtariSim(num_envs, seed)
     return GymVectorEnv(env, num_envs)

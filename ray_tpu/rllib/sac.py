@@ -1,0 +1,362 @@
+"""SAC: soft actor-critic for continuous action spaces on a JAX learner.
+
+Reference analog: ``rllib/algorithms/sac/sac.py:23,280`` (SACConfig/SAC)
+and ``sac_torch_policy.py`` (twin Q networks, tanh-squashed Gaussian
+actor, entropy temperature autotuning) — re-founded on JAX: the actor,
+both critics, their polyak targets, and log_alpha live in one param
+pytree, and the whole update (critic step, actor step, alpha step,
+target polyak) is a single jit-compiled program on the learner device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.common import truncated_normal
+from .algorithm import Algorithm, AlgorithmConfig
+from .replay_buffers import ReplayBuffer
+from .rollout_worker import RolloutWorker
+from .sample_batch import (
+    ACTIONS,
+    DONES,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def _init_mlp(key, sizes, out_dim: int, out_std: float = 0.01) -> Dict:
+    params = {}
+    keys = jax.random.split(key, len(sizes) + 1)
+    for i in range(len(sizes) - 1):
+        std = float(np.sqrt(2.0 / sizes[i]))
+        params[f"t{i}_w"] = truncated_normal(
+            keys[i], (sizes[i], sizes[i + 1]), stddev=std)
+        params[f"t{i}_b"] = jnp.zeros((sizes[i + 1],))
+    params["out_w"] = truncated_normal(keys[-1], (sizes[-1], out_dim),
+                                       stddev=out_std)
+    params["out_b"] = jnp.zeros((out_dim,))
+    return params
+
+
+def _mlp(params: Dict, x: jnp.ndarray) -> jnp.ndarray:
+    i = 0
+    while f"t{i}_w" in params:
+        x = jax.nn.relu(x @ params[f"t{i}_w"] + params[f"t{i}_b"])
+        i += 1
+    return x @ params["out_w"] + params["out_b"]
+
+
+def init_sac_params(key, obs_dim: int, action_dim: int,
+                    hidden=(256, 256)) -> Dict:
+    """Actor + twin critics + their polyak targets + log_alpha."""
+    ka, k1, k2 = jax.random.split(key, 3)
+    sizes = [obs_dim] + list(hidden)
+    qsizes = [obs_dim + action_dim] + list(hidden)
+    q1 = _init_mlp(k1, qsizes, 1, out_std=0.1)
+    q2 = _init_mlp(k2, qsizes, 1, out_std=0.1)
+    return {
+        "actor": _init_mlp(ka, sizes, 2 * action_dim),
+        "q1": q1, "q2": q2,
+        "target_q1": jax.tree.map(jnp.copy, q1),
+        "target_q2": jax.tree.map(jnp.copy, q2),
+        "log_alpha": jnp.zeros(()),
+    }
+
+
+def actor_dist(actor: Dict, obs: jnp.ndarray, action_dim: int):
+    out = _mlp(actor, obs.astype(jnp.float32))
+    mean, log_std = out[..., :action_dim], out[..., action_dim:]
+    return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+
+def sample_action(actor: Dict, obs, key, action_dim: int, low, high):
+    """Reparameterized tanh-squashed Gaussian sample -> (action, logp).
+
+    logp includes the tanh change-of-variables correction
+    (sac_torch_policy: SquashedGaussian.logp).
+    """
+    mean, log_std = actor_dist(actor, obs, action_dim)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mean.shape)
+    pre_tanh = mean + std * eps
+    tanh_a = jnp.tanh(pre_tanh)
+    # N(mean, std) log-density of pre_tanh
+    logp = -0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+    # d tanh / dx correction, numerically stable form
+    logp = logp - 2.0 * (jnp.log(2.0) - pre_tanh
+                         - jax.nn.softplus(-2.0 * pre_tanh))
+    logp = jnp.sum(logp, axis=-1)
+    scale = (high - low) / 2.0
+    action = low + (tanh_a + 1.0) * scale
+    # affine rescale: logp -= sum(log scale)
+    logp = logp - jnp.sum(jnp.log(scale) * jnp.ones_like(tanh_a), axis=-1)
+    return action, logp
+
+
+def _q(params: Dict, obs, act) -> jnp.ndarray:
+    x = jnp.concatenate([obs.astype(jnp.float32),
+                         act.astype(jnp.float32)], axis=-1)
+    return _mlp(params, x)[..., 0]
+
+
+class SACPolicy:
+    """Stochastic tanh-Gaussian policy for rollouts (CPU-jit)."""
+
+    def __init__(self, obs_shape: Tuple[int, ...], action_dim: int,
+                 low: float, high: float, hidden=(256, 256), seed: int = 0):
+        self.obs_dim = int(np.prod(obs_shape))
+        self.action_dim = action_dim
+        self.low, self.high = float(low), float(high)
+        self.params = init_sac_params(
+            jax.random.PRNGKey(seed), self.obs_dim, action_dim, hidden)
+        self._key = jax.random.PRNGKey(seed + 1)
+        adim = action_dim
+
+        @jax.jit
+        def _sample(actor, obs, key):
+            return sample_action(actor, obs, key, adim,
+                                 self.low, self.high)
+
+        @jax.jit
+        def _mean_act(actor, obs):
+            mean, _ = actor_dist(actor, obs, adim)
+            scale = (self.high - self.low) / 2.0
+            return self.low + (jnp.tanh(mean) + 1.0) * scale
+
+        self._sample = _sample
+        self._mean_act = _mean_act
+
+    def compute_actions(self, obs: np.ndarray, deterministic: bool = False):
+        obs = np.asarray(obs, np.float32).reshape(len(obs), -1)
+        if deterministic:
+            actions = np.asarray(self._mean_act(
+                self.params["actor"], jnp.asarray(obs)))
+            logp = np.zeros(len(obs), np.float32)
+        else:
+            self._key, sub = jax.random.split(self._key)
+            a, lp = self._sample(self.params["actor"], jnp.asarray(obs), sub)
+            actions, logp = np.asarray(a), np.asarray(lp, np.float32)
+        zeros = np.zeros(len(obs), np.float32)
+        return actions.astype(np.float32), logp, zeros
+
+    def get_weights(self) -> Dict:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Dict) -> None:
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
+class SACRolloutWorker(RolloutWorker):
+    """Collects flat (s, a, r, s', done) transitions with FLOAT actions
+    (the DQN worker's layout, continuous actions)."""
+
+    def _make_policy(self, cfg: Dict, seed: int):
+        return SACPolicy(
+            self.env.observation_space_shape, self.env.action_dim,
+            self.env.action_low, self.env.action_high,
+            hidden=cfg.get("hidden", (256, 256)), seed=seed,
+        )
+
+    def sample(self, rollout_length: int = 64) -> SampleBatch:
+        n = self.env.num_envs
+        shape = tuple(self.env.observation_space_shape)
+        adim = self.env.action_dim
+        obs_buf = np.empty((rollout_length, n) + shape, np.float32)
+        nobs_buf = np.empty((rollout_length, n) + shape, np.float32)
+        act_buf = np.empty((rollout_length, n, adim), np.float32)
+        rew_buf = np.empty((rollout_length, n), np.float32)
+        done_buf = np.empty((rollout_length, n), bool)
+        for t in range(rollout_length):
+            actions, _, _ = self.policy.compute_actions(self._obs)
+            obs_buf[t] = self._obs
+            act_buf[t] = actions.reshape(n, adim)
+            next_obs, rewards, dones, _ = self.env.vector_step(actions)
+            nobs_buf[t] = next_obs
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+            self._episode_rewards += rewards
+            for i in np.nonzero(dones)[0]:
+                self._completed.append(float(self._episode_rewards[i]))
+                self._episode_rewards[i] = 0.0
+            self._obs = next_obs
+        flat = lambda a: a.reshape((-1,) + a.shape[2:])
+        return SampleBatch({
+            OBS: flat(obs_buf), ACTIONS: flat(act_buf),
+            REWARDS: flat(rew_buf), DONES: flat(done_buf),
+            NEXT_OBS: flat(nobs_buf),
+        })
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self._algo_class = SAC
+        self.env = "FastPendulum"
+        self.lr = 3e-4
+        self.rollout_fragment_length = 8
+        self.train_batch_size = 256
+        self.buffer_capacity = 100_000
+        self.learning_starts = 1_000
+        self.tau = 0.005  # polyak target rate
+        self.num_updates_per_iter = 32
+        self.initial_alpha = 1.0
+        self.target_entropy: float = None  # default: -action_dim
+        self.policy_hidden = (256, 256)
+
+    def training(self, **kwargs) -> "SACConfig":
+        for k in ("buffer_capacity", "learning_starts", "tau",
+                  "num_updates_per_iter", "initial_alpha",
+                  "target_entropy"):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        super().training(**kwargs)
+        return self
+
+
+class SAC(Algorithm):
+    """training_step: sample -> replay add -> K jit updates -> sync.
+
+    One jit program per update: critic step (twin-Q TD toward the soft
+    target), actor step (reparameterized, maximizing Q - alpha*logp),
+    alpha step (toward target entropy), polyak target update.
+    Reference: ``sac.py SAC.training_step`` (:280).
+    """
+
+    _worker_cls = SACRolloutWorker
+
+    def setup(self, config: SACConfig) -> None:
+        import optax
+
+        super().setup(config)
+        env = self.workers.local_worker.env
+        self.action_dim = env.action_dim
+        low, high = float(env.action_low), float(env.action_high)
+        self.buffer = ReplayBuffer(config.buffer_capacity, seed=config.seed)
+        self.params = self.workers.local_worker.policy.params
+        if config.initial_alpha != 1.0:
+            self.params["log_alpha"] = jnp.asarray(
+                np.log(config.initial_alpha), jnp.float32)
+        target_entropy = (config.target_entropy
+                          if config.target_entropy is not None
+                          else -float(self.action_dim))
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(
+            {"actor": self.params["actor"], "q1": self.params["q1"],
+             "q2": self.params["q2"],
+             "log_alpha": self.params["log_alpha"]})
+        self._num_updates = 0
+        gamma, tau, adim = config.gamma, config.tau, self.action_dim
+        optimizer = self.optimizer
+
+        def losses(train_params, target_q1, target_q2, batch, key):
+            actor = train_params["actor"]
+            alpha = jax.lax.stop_gradient(
+                jnp.exp(train_params["log_alpha"]))
+            k1, k2 = jax.random.split(key)
+            # -- critic loss: soft Bellman target from the CURRENT actor
+            next_a, next_logp = sample_action(
+                jax.lax.stop_gradient(actor), batch[NEXT_OBS], k1, adim,
+                low, high)
+            tq = jnp.minimum(_q(target_q1, batch[NEXT_OBS], next_a),
+                             _q(target_q2, batch[NEXT_OBS], next_a))
+            not_done = 1.0 - batch[DONES].astype(jnp.float32)
+            target = batch[REWARDS] + gamma * not_done * (
+                tq - alpha * next_logp)
+            target = jax.lax.stop_gradient(target)
+            q1 = _q(train_params["q1"], batch[OBS], batch[ACTIONS])
+            q2 = _q(train_params["q2"], batch[OBS], batch[ACTIONS])
+            critic_loss = jnp.mean((q1 - target) ** 2) + jnp.mean(
+                (q2 - target) ** 2)
+            # -- actor loss: maximize E[min Q - alpha logp] (reparam)
+            a, logp = sample_action(actor, batch[OBS], k2, adim, low, high)
+            q_pi = jnp.minimum(
+                _q(jax.lax.stop_gradient(train_params["q1"]),
+                   batch[OBS], a),
+                _q(jax.lax.stop_gradient(train_params["q2"]),
+                   batch[OBS], a))
+            actor_loss = jnp.mean(alpha * logp - q_pi)
+            # -- temperature loss: autotune toward target entropy
+            alpha_loss = -jnp.mean(
+                train_params["log_alpha"]
+                * jax.lax.stop_gradient(logp + target_entropy))
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {"critic_loss": critic_loss,
+                           "actor_loss": actor_loss,
+                           "alpha": alpha,
+                           "entropy": -jnp.mean(logp)}
+
+        @jax.jit
+        def update(params, opt_state, batch, key):
+            train = {"actor": params["actor"], "q1": params["q1"],
+                     "q2": params["q2"], "log_alpha": params["log_alpha"]}
+            grads, aux = jax.grad(losses, has_aux=True)(
+                train, params["target_q1"], params["target_q2"], batch,
+                key)
+            updates, opt_state = optimizer.update(grads, opt_state, train)
+            train = optax.apply_updates(train, updates)
+            new = dict(train)
+            polyak = lambda t, o: jax.tree.map(
+                lambda a, b: (1 - tau) * a + tau * b, t, o)
+            new["target_q1"] = polyak(params["target_q1"], train["q1"])
+            new["target_q2"] = polyak(params["target_q2"], train["q2"])
+            return new, opt_state, aux
+
+        self._update = update
+        self._key = jax.random.PRNGKey(config.seed + 17)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        batches = self.workers.sample(cfg.rollout_fragment_length)
+        new_steps = 0
+        for b in batches:
+            self.buffer.add(b)
+            new_steps += b.count
+        self._timesteps_total += new_steps
+
+        aux_out = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iter):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                jbatch = {k: jnp.asarray(v) for k, v in batch.items()
+                          if k != "batch_indexes"}
+                self._key, sub = jax.random.split(self._key)
+                self.params, self.opt_state, aux = self._update(
+                    self.params, self.opt_state, jbatch, sub)
+                self._num_updates += 1
+            aux_out = {k: float(v) for k, v in aux.items()}
+            weights = jax.tree.map(np.asarray, self.params)
+            self.workers.local_worker.set_weights(weights)
+            self.workers.sync_weights(weights)
+
+        return {
+            "timesteps_this_iter": new_steps,
+            "num_learner_updates": self._num_updates,
+            "replay_buffer_size": len(self.buffer),
+            **aux_out,
+        }
+
+    def get_state(self) -> Dict:
+        state = super().get_state()
+        state.update({
+            "params": jax.tree.map(np.asarray, self.params),
+            "num_updates": self._num_updates,
+        })
+        return state
+
+    def set_state(self, state: Dict) -> None:
+        super().set_state(state)
+        if "params" in state:
+            self.params = jax.tree.map(jnp.asarray, state["params"])
+            self._num_updates = state.get("num_updates", 0)
+            weights = jax.tree.map(np.asarray, self.params)
+            self.workers.local_worker.set_weights(weights)
+            self.workers.sync_weights(weights)
