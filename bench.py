@@ -217,6 +217,8 @@ def bench_core() -> dict:
             # in round 2 (4.6 *GB/s* looked like 4.6 puts/s).
             out[key + "_GBps"] = row["GB_per_s"]
             out[key + "_ops_per_s"] = row["ops_per_s"]
+            if "vs_memcpy" in row:
+                out[key + "_vs_memcpy"] = row["vs_memcpy"]
         else:
             out[key] = row["ops_per_s"]
     return out

@@ -44,24 +44,44 @@ class WorkerHandle:
         self._pool = pool
         self._sendq: List = []
         self._send_queued = False
+        # True while the pool sender has drained this worker's batch but
+        # not yet written it to the pipe — the inline fast path must not
+        # jump ahead of it (FIFO), see send().
+        self._send_inflight = False
 
     def send(self, msg) -> bool:
-        """Enqueue for the pool's sender thread, which coalesces bursts
-        into one pipe frame (reference: batched task pushes amortizing
-        per-RPC overhead in ``direct_task_transport``). Optimistic True:
-        pipe failures surface via the reader loop's death path."""
+        """Send inline when this worker's outbound path is idle;
+        otherwise enqueue for the pool's sender thread, which coalesces
+        bursts into one pipe frame (reference: batched task pushes
+        amortizing per-RPC overhead in ``direct_task_transport``). The
+        inline path skips a cross-thread handoff per message (costly on
+        1-core hosts, r3 sync-call regression); FIFO is preserved by
+        taking the pipe lock UNDER the pool's send condition — any
+        later message either queues behind the in-flight send (lock
+        held) or is drained by the sender thread, which serializes on
+        the same lock. Queued sends report optimistic True: pipe
+        failures surface via the reader loop's death path."""
         if self.state == WorkerHandle.DEAD:
             return False
         pool = self._pool
         if pool is None or pool._stopped.is_set():
             return self._raw_send(msg)
         with pool._send_cond:
-            self._sendq.append(msg)
-            if not self._send_queued:
-                self._send_queued = True
-                pool._send_pending.append(self)
-            pool._send_cond.notify()
-        return True
+            if (self._sendq or self._send_queued or self._send_inflight
+                    or not self._send_lock.acquire(False)):
+                self._sendq.append(msg)
+                if not self._send_queued:
+                    self._send_queued = True
+                    pool._send_pending.append(self)
+                pool._send_cond.notify()
+                return True
+        try:
+            self.conn.send(msg)
+            return True
+        except (BrokenPipeError, OSError):
+            return False
+        finally:
+            self._send_lock.release()
 
     def _raw_send(self, msg) -> bool:
         with self._send_lock:
@@ -133,12 +153,22 @@ class WorkerPool:
                     msgs, w._sendq = w._sendq, []
                     w._send_queued = False
                     if msgs:
+                        # Marked under the cond BEFORE the drain is
+                        # visible outside it: an inline send racing with
+                        # this window (queue empty, lock free — we only
+                        # take _send_lock later in _raw_send) would
+                        # otherwise write the pipe ahead of this batch.
+                        w._send_inflight = True
                         batches.append((w, msgs))
                 self._send_pending.clear()
             for w, msgs in batches:
-                if w.state == WorkerHandle.DEAD:
-                    continue
-                w._raw_send(msgs[0] if len(msgs) == 1 else ("batch", msgs))
+                if w.state != WorkerHandle.DEAD:
+                    w._raw_send(msgs[0] if len(msgs) == 1
+                                else ("batch", msgs))
+            if batches:
+                with self._send_cond:
+                    for w, _ in batches:
+                        w._send_inflight = False
 
     def _start_worker(self) -> WorkerHandle:
         from .worker_main import worker_entry

@@ -62,11 +62,28 @@ def main(duration: float = 2.0) -> List[Dict]:
     # put throughput: large objects GB/s
     big = np.zeros(10 * 1024 * 1024 // 8, dtype=np.float64)  # 10MB
 
+    # Machine memcpy ceiling for the same payload: put is ONE memcpy
+    # into the shm arena by design (plasma semantics — the source value
+    # lives in caller memory, so one copy is the floor), while get is a
+    # zero-copy view; their ops/s are not comparable. Report put as a
+    # fraction of this ceiling instead.
+    dst = bytearray(big.nbytes)
+    dst_view = memoryview(dst)
+    src_view = memoryview(big).cast("B")
+    dst_view[:] = src_view  # prefault
+    r = timeit("memcpy ceiling (10MB)",
+               lambda: dst_view.__setitem__(slice(None), src_view),
+               duration=duration)
+    r["GB_per_s"] = round(r["ops_per_s"] * 10 / 1024, 3)
+    memcpy_gbps = r["GB_per_s"]
+    results.append(r)
+
     def put_big():
         rt.put(big)
 
     r = timeit("put large (10MB)", put_big, duration=duration)
     r["GB_per_s"] = round(r["ops_per_s"] * 10 / 1024, 3)
+    r["vs_memcpy"] = round(r["GB_per_s"] / max(memcpy_gbps, 1e-9), 3)
     results.append(r)
 
     # get throughput: large object
