@@ -263,9 +263,96 @@ void* rt_store_attach(const char* name) {
   return s;
 }
 
-static int lock_robust(StoreHeader* h) {
+// Rebuild allocator + table invariants after a lock owner died inside a
+// critical section (EOWNERDEAD): the dead process may have left slot
+// fields half-written or the free-list splice mid-update. The object
+// table is the source of truth — every structurally valid allocated
+// slot keeps its extent; half-written slots are tombstoned; the free
+// list is rebuilt as the sorted, coalesced complement of the kept
+// extents. Caller holds the (just-made-consistent) mutex.
+// Reference concern: plasma's server-mediated design never exposes
+// clients to each other's locks (plasma/store.h:55); the direct-mapped
+// arena earns the same safety here.
+static void repair_store(Store* s) {
+  StoreHeader* h = header(s);
+  Slot* t = table(s);
+  struct Extent {
+    uint64_t off;
+    uint64_t size;
+    Slot* slot;
+  };
+  Extent* exts = new Extent[kTableSize];
+  uint64_t n = 0;
+  uint64_t sealed = 0;
+  for (uint32_t i = 0; i < kTableSize; i++) {
+    Slot* slot = &t[i];
+    if (slot->state != SLOT_CREATED && slot->state != SLOT_SEALED &&
+        slot->state != SLOT_PENDING_DELETE) {
+      continue;
+    }
+    bool valid = slot->alloc_size > 0 &&
+                 slot->offset + slot->alloc_size <= h->capacity &&
+                 slot->size <= slot->alloc_size;
+    if (!valid) {  // half-written by the dead owner
+      slot->state = SLOT_TOMBSTONE;
+      continue;
+    }
+    exts[n++] = {slot->offset, slot->alloc_size, slot};
+  }
+  // Insertion sort by offset (n is small in practice; bounded by table).
+  for (uint64_t i = 1; i < n; i++) {
+    Extent e = exts[i];
+    uint64_t j = i;
+    while (j > 0 && exts[j - 1].off > e.off) {
+      exts[j] = exts[j - 1];
+      j--;
+    }
+    exts[j] = e;
+  }
+  // Drop overlapping extents (a torn allocation): keep the earlier one.
+  uint64_t used = 0;
+  uint64_t kept = 0;
+  uint64_t prev_end = 0;
+  for (uint64_t i = 0; i < n; i++) {
+    if (exts[i].off < prev_end) {
+      exts[i].slot->state = SLOT_TOMBSTONE;
+      continue;
+    }
+    prev_end = exts[i].off + exts[i].size;
+    used += exts[i].size;
+    exts[kept++] = exts[i];
+  }
+  // Rebuild the free list from the gaps between kept extents.
+  uint64_t free_head = 0;
+  uint64_t* link = &free_head;  // where to write the next block's off+1
+  uint64_t cursor = 0;
+  for (uint64_t i = 0; i <= kept; i++) {
+    uint64_t gap_end = (i < kept) ? exts[i].off : h->capacity;
+    if (gap_end > cursor && gap_end - cursor >= sizeof(FreeBlock)) {
+      FreeBlock* blk = reinterpret_cast<FreeBlock*>(arena(s) + cursor);
+      blk->size = gap_end - cursor;
+      blk->next = 0;
+      *link = cursor + 1;
+      link = &blk->next;
+    }
+    if (i < kept) cursor = exts[i].off + exts[i].size;
+  }
+  h->free_head = free_head;
+  h->used_bytes = used;
+  for (uint64_t i = 0; i < kept; i++) {
+    if (exts[i].slot->state == SLOT_SEALED) sealed++;
+  }
+  h->num_objects = sealed;
+  delete[] exts;
+}
+
+static int lock_robust(Store* s) {
+  StoreHeader* h = header(s);
   int rc = pthread_mutex_lock(&h->mutex);
   if (rc == EOWNERDEAD) {
+    // The mutex is usable again, but the state it guarded may be torn —
+    // repair before letting anyone allocate from it.
+    repair_store(s);
     pthread_mutex_consistent(&h->mutex);
     rc = 0;
   }
@@ -279,7 +366,7 @@ int rt_store_put(void* handle, const uint8_t* key, const uint8_t* data,
                  uint64_t size) {
   Store* s = static_cast<Store*>(handle);
   StoreHeader* h = header(s);
-  if (lock_robust(h) != 0) return -4;
+  if (lock_robust(s) != 0) return -4;
   Slot* existing = find_slot(s, key, false);
   if (existing && existing->state == SLOT_PENDING_DELETE) {
     pthread_mutex_unlock(&h->mutex);
@@ -323,7 +410,7 @@ uint8_t* rt_store_create_object(void* handle, const uint8_t* key,
   Store* s = static_cast<Store*>(handle);
   StoreHeader* h = header(s);
   *err_out = 0;
-  if (lock_robust(h) != 0) {
+  if (lock_robust(s) != 0) {
     *err_out = -4;
     return nullptr;
   }
@@ -363,7 +450,7 @@ uint8_t* rt_store_create_object(void* handle, const uint8_t* key,
 int rt_store_abort(void* handle, const uint8_t* key) {
   Store* s = static_cast<Store*>(handle);
   StoreHeader* h = header(s);
-  if (lock_robust(h) != 0) return -4;
+  if (lock_robust(s) != 0) return -4;
   Slot* slot = find_slot(s, key, false);
   if (!slot || slot->state != SLOT_CREATED) {
     pthread_mutex_unlock(&h->mutex);
@@ -378,7 +465,7 @@ int rt_store_abort(void* handle, const uint8_t* key) {
 int rt_store_seal(void* handle, const uint8_t* key) {
   Store* s = static_cast<Store*>(handle);
   StoreHeader* h = header(s);
-  if (lock_robust(h) != 0) return -4;
+  if (lock_robust(s) != 0) return -4;
   Slot* slot = find_slot(s, key, false);
   if (!slot || slot->state != SLOT_CREATED) {
     pthread_mutex_unlock(&h->mutex);
@@ -396,7 +483,7 @@ const uint8_t* rt_store_get(void* handle, const uint8_t* key,
                             uint64_t* size_out) {
   Store* s = static_cast<Store*>(handle);
   StoreHeader* h = header(s);
-  if (lock_robust(h) != 0) return nullptr;
+  if (lock_robust(s) != 0) return nullptr;
   Slot* slot = find_slot(s, key, false);
   if (!slot || slot->state != SLOT_SEALED) {
     pthread_mutex_unlock(&h->mutex);
@@ -412,7 +499,7 @@ const uint8_t* rt_store_get(void* handle, const uint8_t* key,
 int rt_store_release(void* handle, const uint8_t* key) {
   Store* s = static_cast<Store*>(handle);
   StoreHeader* h = header(s);
-  if (lock_robust(h) != 0) return -4;
+  if (lock_robust(s) != 0) return -4;
   Slot* slot = find_slot(s, key, false);
   if (slot && slot->refcount > 0) {
     slot->refcount--;
@@ -428,7 +515,7 @@ int rt_store_release(void* handle, const uint8_t* key) {
 int rt_store_contains(void* handle, const uint8_t* key) {
   Store* s = static_cast<Store*>(handle);
   StoreHeader* h = header(s);
-  if (lock_robust(h) != 0) return 0;
+  if (lock_robust(s) != 0) return 0;
   Slot* slot = find_slot(s, key, false);
   int ok = (slot && slot->state == SLOT_SEALED) ? 1 : 0;
   pthread_mutex_unlock(&h->mutex);
@@ -443,7 +530,7 @@ int rt_store_contains(void* handle, const uint8_t* key) {
 int rt_store_delete(void* handle, const uint8_t* key) {
   Store* s = static_cast<Store*>(handle);
   StoreHeader* h = header(s);
-  if (lock_robust(h) != 0) return -4;
+  if (lock_robust(s) != 0) return -4;
   Slot* slot = find_slot(s, key, false);
   if (!slot || slot->state == SLOT_FREE ||
       slot->state == SLOT_PENDING_DELETE) {
@@ -467,7 +554,7 @@ void rt_store_stats(void* handle, uint64_t* capacity, uint64_t* used,
                     uint64_t* num_objects) {
   Store* s = static_cast<Store*>(handle);
   StoreHeader* h = header(s);
-  lock_robust(h);
+  lock_robust(s);
   *capacity = h->capacity;
   *used = h->used_bytes;
   *num_objects = h->num_objects;
@@ -480,6 +567,39 @@ void rt_store_close(void* handle, int unlink_shm) {
   close(s->fd);
   if (unlink_shm) shm_unlink(s->name);
   delete s;
+}
+
+// TEST ONLY: take the store mutex and return WITHOUT unlocking. A
+// process that calls this and exits (or is SIGKILLed) simulates dying
+// inside a critical section: the kernel's robust-futex list marks the
+// mutex OWNER_DIED, the next locker gets EOWNERDEAD, and lock_robust
+// runs repair_store. Never called by the runtime.
+int rt_store_test_lock_hold(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  return pthread_mutex_lock(&header(s)->mutex);
+}
+
+// TEST ONLY: simulate a writer dying MID-ALLOCATION — take the mutex,
+// scribble a torn slot (CREATED state, impossible extent) and corrupt
+// the free-list head, then return still holding the lock. The caller
+// process then exits; the next locker's repair must tombstone the torn
+// slot and rebuild the free list from the surviving table entries.
+int rt_store_test_die_mid_alloc(void* handle, const uint8_t* key) {
+  Store* s = static_cast<Store*>(handle);
+  StoreHeader* h = header(s);
+  int rc = pthread_mutex_lock(&h->mutex);
+  if (rc != 0 && rc != EOWNERDEAD) return rc;
+  Slot* slot = find_slot(s, key, true);
+  if (slot) {
+    memcpy(slot->key, key, kKeySize);
+    slot->offset = h->capacity * 2;  // structurally invalid
+    slot->size = 1;
+    slot->alloc_size = 0;
+    slot->refcount = 0;
+    slot->state = SLOT_CREATED;
+  }
+  h->free_head = h->capacity + 7;  // dangling free-list head
+  return 0;
 }
 
 }  // extern "C"
