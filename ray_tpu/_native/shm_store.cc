@@ -290,8 +290,11 @@ static void repair_store(Store* s) {
         slot->state != SLOT_PENDING_DELETE) {
       continue;
     }
-    bool valid = slot->alloc_size > 0 &&
-                 slot->offset + slot->alloc_size <= h->capacity &&
+    // Overflow-safe bounds check: offset + alloc_size could wrap uint64
+    // for a torn slot with a huge offset, sneaking it past `<= capacity`
+    // and corrupting the rebuilt free list.
+    bool valid = slot->alloc_size > 0 && slot->offset <= h->capacity &&
+                 slot->alloc_size <= h->capacity - slot->offset &&
                  slot->size <= slot->alloc_size;
     if (!valid) {  // half-written by the dead owner
       slot->state = SLOT_TOMBSTONE;

@@ -149,6 +149,46 @@ def cmd_start(args) -> int:
     ])
 
 
+def cmd_serve(args) -> int:
+    """Config-file Serve ops (reference: ``serve deploy/config/status``,
+    ``python/ray/serve/scripts.py:106,172``)."""
+    import ray_tpu as rt
+    from ray_tpu.serve import schema as serve_schema
+
+    if args.serve_command == "deploy":
+        rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
+        schema = serve_schema.ServeDeploySchema.from_file(args.config_file)
+        deployed = serve_schema.apply(schema)
+        print(json.dumps({"deployed": deployed}, indent=2))
+        if args.block:
+            import time
+
+            print("serving; Ctrl-C to stop", flush=True)
+            try:
+                while True:
+                    time.sleep(1)
+            except KeyboardInterrupt:
+                pass
+        return 0
+    if args.serve_command == "config":
+        # Validate + echo the normalized config without deploying.
+        schema = serve_schema.ServeDeploySchema.from_file(args.config_file)
+        print(json.dumps(schema.to_dict(), indent=2))
+        return 0
+    if args.serve_command == "status":
+        rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
+        print(json.dumps(serve_schema.status(), indent=2, default=str))
+        return 0
+    if args.serve_command == "shutdown":
+        rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
+        from ray_tpu import serve as serve_api
+
+        serve_api.shutdown()
+        print("serve shut down")
+        return 0
+    return 2
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="rt", description=__doc__)
     p.add_argument("--num-cpus", type=float, default=None)
@@ -178,6 +218,18 @@ def build_parser() -> argparse.ArgumentParser:
     mb.add_argument("--duration", type=float, default=2.0)
     dp = sub.add_parser("dashboard", help="serve the state/metrics HTTP API")
     dp.add_argument("--port", type=int, default=8265)
+
+    svp = sub.add_parser("serve", help="config-file Serve ops "
+                                       "(deploy/config/status/shutdown)")
+    svsub = svp.add_subparsers(dest="serve_command", required=True)
+    sdp = svsub.add_parser("deploy", help="apply a YAML/JSON app config")
+    sdp.add_argument("config_file")
+    sdp.add_argument("--block", action="store_true",
+                     help="keep serving in the foreground")
+    scp = svsub.add_parser("config", help="validate + echo a config file")
+    scp.add_argument("config_file")
+    svsub.add_parser("status", help="deployment replica/route status")
+    svsub.add_parser("shutdown", help="tear down all deployments")
     return p
 
 
@@ -191,6 +243,7 @@ def main(argv=None) -> int:
         "timeline": cmd_timeline,
         "microbenchmark": cmd_microbenchmark,
         "dashboard": cmd_dashboard,
+        "serve": cmd_serve,
     }
     return handlers[args.command](args)
 

@@ -4,6 +4,11 @@ Reference analog: ``python/ray/serve``.
 """
 
 from ._internal import AutoscalingConfig, DeploymentInfo, ServeController
+from .schema import (
+    DeploymentSchema,
+    ServeApplicationSchema,
+    ServeDeploySchema,
+)
 from .api import (
     Application,
     Deployment,
@@ -19,6 +24,7 @@ from .api import (
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentHandle",
-    "DeploymentInfo", "ServeController", "batch", "deployment",
+    "DeploymentInfo", "DeploymentSchema", "ServeApplicationSchema",
+    "ServeController", "ServeDeploySchema", "batch", "deployment",
     "get_deployment_handle", "list_deployments", "run", "shutdown", "start",
 ]

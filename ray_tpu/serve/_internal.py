@@ -51,6 +51,7 @@ class DeploymentInfo:
     ray_actor_options: dict = field(default_factory=dict)
     version: int = 0
     request_timeout_s: Optional[float] = None
+    user_config: Optional[dict] = None
 
 
 class _Replica:
@@ -284,6 +285,26 @@ class ServeController:
             return (self._versions.get(name, 0),
                     list(self.replicas.get(name, [])))
 
+    def reconfigure_deployment(self, name: str, user_config) -> int:
+        """Push a new user_config to every live replica in parallel;
+        returns how many acknowledged (reference: controller.py
+        deploy-with-user_config → replica reconfigure; the config-file
+        ops path sets this per deployment). New replicas pick the config
+        up at creation (_reconcile_deployment)."""
+        with self._lock:
+            info = self.deployments.get(name)
+            if info is None:
+                return -1
+            info.user_config = user_config
+            replicas = list(self.replicas.get(name, []))
+        if not replicas:
+            return 0
+        from ..core import wait as _wait
+
+        refs = [r.reconfigure.remote(user_config) for r in replicas]
+        done, _pending = _wait(refs, num_returns=len(refs), timeout=30)
+        return len(done)
+
     def list_deployments(self) -> Dict[str, dict]:
         with self._lock:
             return self._list_deployments_locked()
@@ -397,6 +418,11 @@ class ServeController:
                 **opts,
             ).remote(info.deployment_def, info.init_args, info.init_kwargs,
                      request_timeout_s=info.request_timeout_s)
+            if info.user_config is not None:
+                # New replicas (autoscale/replacement) must see the same
+                # user_config as the running set — fire-and-forget; the
+                # actor queue orders it before any routed request.
+                actor.reconfigure.remote(info.user_config)
             current.append(actor)
         while len(current) > target:
             victim = current.pop()

@@ -28,7 +28,7 @@ from ._internal import (
 
 _CONTROLLER_NAME = "SERVE_CONTROLLER"
 _state: Dict[str, Any] = {"controller": None, "http_server": None,
-                          "routers": []}
+                          "routers": [], "http_addr": None}
 
 
 def start(http_port: int = 8000, http_host: str = "127.0.0.1",
@@ -38,6 +38,14 @@ def start(http_port: int = 8000, http_host: str = "127.0.0.1",
     ServeController actor, controller.py:229) + the HTTP proxy. Serve
     survives driver-side handle GC — only serve.shutdown() stops it."""
     if _state["controller"] is not None:
+        current = _state.get("http_addr")
+        if current is not None and current != (http_host, http_port):
+            import sys
+
+            print(f"serve: already running with HTTP on "
+                  f"{current[0]}:{current[1]}; requested "
+                  f"{http_host}:{http_port} ignored — serve.shutdown() "
+                  "first to change http_options", file=sys.stderr)
         return
     controller_cls = remote(ServeController)
     controller = controller_cls.options(
@@ -47,6 +55,13 @@ def start(http_port: int = 8000, http_host: str = "127.0.0.1",
     get(controller.start_loop.remote(), timeout=30)
     _state["controller"] = controller
     _start_http_proxy(http_host, http_port)
+    _state["http_addr"] = (http_host, http_port)
+
+
+def is_running() -> bool:
+    """True when a Serve controller exists in THIS driver process —
+    a read-only probe that never starts an instance."""
+    return _state["controller"] is not None
 
 
 def shutdown() -> None:
@@ -69,6 +84,7 @@ def shutdown() -> None:
         except Exception:
             pass
         _state["http_server"] = None
+    _state["http_addr"] = None
     controller = _state.get("controller")
     if controller is not None:
         try:

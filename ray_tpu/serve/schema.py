@@ -1,0 +1,227 @@
+"""Declarative Serve config: schema + apply — the production ops path.
+
+Reference analog: ``python/ray/serve/schema.py:227``
+(``ServeApplicationSchema`` / ``ServeDeploySchema``) and the config-file
+flow of ``serve deploy`` (``python/ray/serve/scripts.py:106,172``): a
+YAML/JSON file names applications by import path, overrides per-
+deployment options, and is idempotently applied to the running cluster.
+"""
+
+from __future__ import annotations
+
+import importlib
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+_DEPLOYMENT_FIELDS = (
+    "num_replicas", "max_concurrent_queries", "route_prefix",
+    "autoscaling_config", "ray_actor_options", "request_timeout_s",
+)
+
+
+@dataclass
+class DeploymentSchema:
+    """Per-deployment overrides (reference: schema.py DeploymentSchema)."""
+
+    name: str
+    num_replicas: Optional[int] = None
+    max_concurrent_queries: Optional[int] = None
+    route_prefix: Optional[str] = None
+    autoscaling_config: Optional[Dict[str, Any]] = None
+    ray_actor_options: Optional[Dict[str, Any]] = None
+    request_timeout_s: Optional[float] = None
+    user_config: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "DeploymentSchema":
+        if "name" not in d:
+            raise ValueError("deployment entry requires a 'name'")
+        unknown = set(d) - {f for f in cls.__dataclass_fields__}
+        if unknown:
+            raise ValueError(
+                f"unknown deployment option(s) {sorted(unknown)} for "
+                f"deployment {d.get('name')!r}")
+        return cls(**d)
+
+    def overrides(self) -> Dict[str, Any]:
+        out = {}
+        for f in _DEPLOYMENT_FIELDS:
+            v = getattr(self, f)
+            if v is not None:
+                out[f] = v
+        return out
+
+
+@dataclass
+class ServeApplicationSchema:
+    """One application: an import path to a bound Application or a
+    Deployment, plus per-deployment overrides (reference:
+    schema.py:227 ServeApplicationSchema)."""
+
+    import_path: str
+    name: str = "default"
+    route_prefix: Optional[str] = None
+    args: Dict[str, Any] = field(default_factory=dict)
+    deployments: List[DeploymentSchema] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ServeApplicationSchema":
+        if "import_path" not in d:
+            raise ValueError("application entry requires 'import_path'")
+        if ":" not in d["import_path"]:
+            raise ValueError(
+                f"import_path {d['import_path']!r} must be "
+                "'module.sub:attribute'")
+        deployments = [DeploymentSchema.from_dict(x)
+                       for x in d.get("deployments", [])]
+        known = {"import_path", "name", "route_prefix", "args",
+                 "deployments"}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown application option(s) {sorted(unknown)}")
+        return cls(
+            import_path=d["import_path"], name=d.get("name", "default"),
+            route_prefix=d.get("route_prefix"), args=d.get("args", {}),
+            deployments=deployments)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+
+@dataclass
+class HTTPOptionsSchema:
+    host: str = "127.0.0.1"
+    port: int = 8000
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "HTTPOptionsSchema":
+        unknown = set(d) - {"host", "port"}
+        if unknown:
+            raise ValueError(f"unknown http option(s) {sorted(unknown)}")
+        return cls(host=d.get("host", "127.0.0.1"),
+                   port=int(d.get("port", 8000)))
+
+
+@dataclass
+class ServeDeploySchema:
+    """The whole config file (reference: ServeDeploySchema)."""
+
+    applications: List[ServeApplicationSchema]
+    http_options: HTTPOptionsSchema = field(
+        default_factory=HTTPOptionsSchema)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ServeDeploySchema":
+        if "applications" not in d or not d["applications"]:
+            raise ValueError("config requires a non-empty 'applications'")
+        unknown = set(d) - {"applications", "http_options"}
+        if unknown:
+            raise ValueError(f"unknown top-level option(s) "
+                             f"{sorted(unknown)}")
+        return cls(
+            applications=[ServeApplicationSchema.from_dict(a)
+                          for a in d["applications"]],
+            http_options=HTTPOptionsSchema.from_dict(
+                d.get("http_options", {})),
+        )
+
+    @classmethod
+    def from_file(cls, path: str) -> "ServeDeploySchema":
+        import json
+
+        with open(path) as f:
+            text = f.read()
+        if path.endswith((".yaml", ".yml")):
+            import yaml
+
+            data = yaml.safe_load(text)
+        else:
+            data = json.loads(text)
+        if not isinstance(data, dict):
+            raise ValueError(f"{path}: config must be a mapping")
+        return cls.from_dict(data)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+
+def _import_target(import_path: str):
+    module_name, _, attr = import_path.partition(":")
+    module = importlib.import_module(module_name)
+    target = module
+    for part in attr.split("."):
+        target = getattr(target, part)
+    return target
+
+
+def apply(schema: ServeDeploySchema) -> Dict[str, Any]:
+    """Deploy every application in the schema to the running cluster
+    (idempotent: re-applying updates deployments in place, the
+    controller reconciles replicas). Returns a name -> route summary."""
+    from . import api
+
+    api.start(http_port=schema.http_options.port,
+              http_host=schema.http_options.host)
+    deployed: Dict[str, Any] = {}
+    for app in schema.applications:
+        target = _import_target(app.import_path)
+        if isinstance(target, api.Application):
+            application = target
+        elif isinstance(target, api.Deployment):
+            application = target.bind(**app.args)
+        elif callable(target):  # app builder fn(args) -> Application
+            application = target(app.args) if app.args else target()
+            if not isinstance(application, api.Application):
+                raise TypeError(
+                    f"{app.import_path} returned "
+                    f"{type(application).__name__}, expected Application")
+        else:
+            raise TypeError(
+                f"{app.import_path} resolves to "
+                f"{type(target).__name__}; expected an Application, "
+                "Deployment, or builder function")
+        dep = application.deployment
+        overrides: Dict[str, Any] = {}
+        user_config = None
+        unmatched = []
+        for dschema in app.deployments:
+            if dschema.name == dep.name:
+                overrides = dschema.overrides()
+                user_config = dschema.user_config
+            else:
+                unmatched.append(dschema.name)
+        if unmatched:
+            # A typo'd name silently dropping overrides is the worst
+            # config-file failure mode — reject it loudly.
+            raise ValueError(
+                f"application {app.name!r}: deployment override(s) "
+                f"{unmatched} do not match the application's deployment "
+                f"{dep.name!r}")
+        if app.route_prefix is not None:
+            overrides.setdefault("route_prefix", app.route_prefix)
+        if overrides:
+            dep = dep.options(**overrides)
+        handle = dep.deploy(*application.args, **application.kwargs)
+        if user_config is not None:
+            from ..core import get as _get
+
+            _get(api._controller().reconfigure_deployment.remote(
+                dep.name, user_config), timeout=30)
+        deployed[app.name] = {
+            "deployment": dep.name,
+            "route_prefix": dep._opts.get("route_prefix",
+                                          f"/{dep.name}"),
+        }
+    return deployed
+
+
+def status() -> Dict[str, Any]:
+    """Serve status (reference: ``serve status``) — read-only: reports
+    not-running instead of implicitly starting an instance (which would
+    spawn a controller and bind the HTTP port as a side effect)."""
+    from . import api
+
+    if not api.is_running():
+        return {"running": False, "deployments": {}}
+    return {"running": True, "deployments": api.list_deployments()}
