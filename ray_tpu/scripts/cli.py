@@ -151,7 +151,14 @@ def cmd_start(args) -> int:
 
 def cmd_serve(args) -> int:
     """Config-file Serve ops (reference: ``serve deploy/config/status``,
-    ``python/ray/serve/scripts.py:106,172``)."""
+    ``python/ray/serve/scripts.py:106,172``).
+
+    NOTE: like the other ``rt`` subcommands, these operate on the
+    IN-PROCESS runtime (single-host deployment mode): ``deploy`` runs
+    the apps in this process (blocking by default — the instance dies
+    with it), and ``status``/``shutdown`` see only this process's
+    instance. Multi-host remote ops attach via the client server
+    (``ray_tpu.client.connect``)."""
     import ray_tpu as rt
     from ray_tpu.serve import schema as serve_schema
 
@@ -160,7 +167,7 @@ def cmd_serve(args) -> int:
         schema = serve_schema.ServeDeploySchema.from_file(args.config_file)
         deployed = serve_schema.apply(schema)
         print(json.dumps({"deployed": deployed}, indent=2))
-        if args.block:
+        if not args.no_block:
             import time
 
             print("serving; Ctrl-C to stop", flush=True)
@@ -169,6 +176,9 @@ def cmd_serve(args) -> int:
                     time.sleep(1)
             except KeyboardInterrupt:
                 pass
+        else:
+            print("warning: --no-block tears the in-process Serve "
+                  "instance down at exit", file=sys.stderr)
         return 0
     if args.serve_command == "config":
         # Validate + echo the normalized config without deploying.
@@ -222,10 +232,12 @@ def build_parser() -> argparse.ArgumentParser:
     svp = sub.add_parser("serve", help="config-file Serve ops "
                                        "(deploy/config/status/shutdown)")
     svsub = svp.add_subparsers(dest="serve_command", required=True)
-    sdp = svsub.add_parser("deploy", help="apply a YAML/JSON app config")
+    sdp = svsub.add_parser("deploy", help="apply a YAML/JSON app config "
+                                          "(blocks; in-process instance)")
     sdp.add_argument("config_file")
-    sdp.add_argument("--block", action="store_true",
-                     help="keep serving in the foreground")
+    sdp.add_argument("--no-block", action="store_true",
+                     help="exit after deploying (tears the in-process "
+                          "instance down)")
     scp = svsub.add_parser("config", help="validate + echo a config file")
     scp.add_argument("config_file")
     svsub.add_parser("status", help="deployment replica/route status")

@@ -67,13 +67,19 @@ class _Replica:
     """
 
     def __init__(self, deployment_def, init_args, init_kwargs,
-                 request_timeout_s: Optional[float] = None):
+                 request_timeout_s: Optional[float] = None,
+                 user_config: Optional[dict] = None):
         import inspect
 
         if inspect.isclass(deployment_def):
             self.callable = deployment_def(*init_args, **init_kwargs)
         else:
             self.callable = deployment_def
+        if user_config is not None:
+            # Applied during construction, BEFORE the replica is
+            # routable — a post-creation reconfigure RPC could race with
+            # routed requests on a concurrent actor.
+            self.reconfigure(user_config)
         self._ongoing = 0
         self._total = 0
         self._timeout = request_timeout_s
@@ -417,12 +423,8 @@ class ServeController:
                 max_concurrency=max(2, info.max_concurrent_queries),
                 **opts,
             ).remote(info.deployment_def, info.init_args, info.init_kwargs,
-                     request_timeout_s=info.request_timeout_s)
-            if info.user_config is not None:
-                # New replicas (autoscale/replacement) must see the same
-                # user_config as the running set — fire-and-forget; the
-                # actor queue orders it before any routed request.
-                actor.reconfigure.remote(info.user_config)
+                     request_timeout_s=info.request_timeout_s,
+                     user_config=info.user_config)
             current.append(actor)
         while len(current) > target:
             victim = current.pop()

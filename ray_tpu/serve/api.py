@@ -31,15 +31,19 @@ _state: Dict[str, Any] = {"controller": None, "http_server": None,
                           "routers": [], "http_addr": None}
 
 
-def start(http_port: int = 8000, http_host: str = "127.0.0.1",
+def start(http_port: Optional[int] = None, http_host: Optional[str] = None,
           detached: bool = True) -> None:
     """Start the Serve instance: a DETACHED controller actor running its
     own control loop (reference: run_control_loop inside the
     ServeController actor, controller.py:229) + the HTTP proxy. Serve
     survives driver-side handle GC — only serve.shutdown() stops it."""
+    explicit = http_port is not None or http_host is not None
+    http_port = 8000 if http_port is None else http_port
+    http_host = "127.0.0.1" if http_host is None else http_host
     if _state["controller"] is not None:
         current = _state.get("http_addr")
-        if current is not None and current != (http_host, http_port):
+        if explicit and current is not None and \
+                current != (http_host, http_port):
             import sys
 
             print(f"serve: already running with HTTP on "
@@ -55,7 +59,6 @@ def start(http_port: int = 8000, http_host: str = "127.0.0.1",
     get(controller.start_loop.remote(), timeout=30)
     _state["controller"] = controller
     _start_http_proxy(http_host, http_port)
-    _state["http_addr"] = (http_host, http_port)
 
 
 def is_running() -> bool:
@@ -219,10 +222,13 @@ class Deployment:
             init_kwargs=init_kwargs,
             num_replicas=o.get("num_replicas", 1),
             max_concurrent_queries=o.get("max_concurrent_queries", 100),
-            route_prefix=o.get("route_prefix", f"/{self.name}"),
+            # `or`, not .get default: the decorator always stores the
+            # key (value None), so a dict default would never fire.
+            route_prefix=o.get("route_prefix") or f"/{self.name}",
             autoscaling=autoscaling,
-            ray_actor_options=o.get("ray_actor_options", {}),
+            ray_actor_options=o.get("ray_actor_options") or {},
             request_timeout_s=o.get("request_timeout_s"),
+            user_config=o.get("user_config"),
         )
         get(_controller().deploy.remote(info), timeout=60)
         return DeploymentHandle(self.name, o.get("max_concurrent_queries",
@@ -474,6 +480,9 @@ def _start_http_proxy(host: str, port: int) -> None:
     proxy = _AsyncHTTPProxy(host, port)
     if proxy._ok:
         _state["http_server"] = proxy
+        # Recorded only on a successful bind: a failed proxy must not
+        # make later start() calls claim HTTP is already being served.
+        _state["http_addr"] = (host, port)
 
 
 # -- batching ----------------------------------------------------------------

@@ -200,18 +200,18 @@ def apply(schema: ServeDeploySchema) -> Dict[str, Any]:
                 f"{dep.name!r}")
         if app.route_prefix is not None:
             overrides.setdefault("route_prefix", app.route_prefix)
+        if user_config is not None:
+            # Carried in DeploymentInfo so every replica applies it at
+            # CONSTRUCTION, before becoming routable (a post-deploy
+            # reconfigure RPC races with routed requests).
+            overrides["user_config"] = user_config
         if overrides:
             dep = dep.options(**overrides)
         handle = dep.deploy(*application.args, **application.kwargs)
-        if user_config is not None:
-            from ..core import get as _get
-
-            _get(api._controller().reconfigure_deployment.remote(
-                dep.name, user_config), timeout=30)
         deployed[app.name] = {
             "deployment": dep.name,
-            "route_prefix": dep._opts.get("route_prefix",
-                                          f"/{dep.name}"),
+            "route_prefix": (dep._opts.get("route_prefix")
+                             or f"/{dep.name}"),
         }
     return deployed
 

@@ -42,9 +42,16 @@ class ScalingConfig:
 
 @dataclass
 class FailureConfig:
-    """Reference: air/config.py FailureConfig — trial-level retries."""
+    """Reference: air/config.py FailureConfig — trial-level retries.
+
+    gang_start_timeout_s: how long a restart may wait for cluster
+    capacity (e.g. spot backfill after a preemption) before the failed
+    reservation burns one of max_failures. The reference parks trials in
+    PENDING while resources are unavailable; the Trainer equivalent is
+    this bounded wait."""
 
     max_failures: int = 0
+    gang_start_timeout_s: float = 120.0
 
 
 @dataclass

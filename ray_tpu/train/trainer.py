@@ -162,16 +162,48 @@ class DataParallelTrainer:
                 latest_ckpt[0] = ckpt
 
         executor = BackendExecutor(self.scaling_config)
-        failures_left = self.run_config.failure_config.max_failures
+        fail_cfg = self.run_config.failure_config
+        failures_left = fail_cfg.max_failures
+        start_deadline: Optional[float] = None
         while True:
-            executor.start()
-            if self._datasets:
-                shards = self._shard_datasets(executor.worker_group)
-                for rank, worker_shards in enumerate(shards):
-                    executor.worker_group.workers[rank].setup_session.remote(
-                        dataset_shards=worker_shards
-                    )
             try:
+                # Gang start gets its own patience budget: after a node
+                # loss (spot preemption) replacement capacity may take a
+                # while to register — waiting for backfill must not burn
+                # max_failures, only exceeding gang_start_timeout_s does.
+                # Only the capacity error (WorkerGroup's reserve
+                # RuntimeError) is retried; config bugs propagate.
+                executor.start()
+            except RuntimeError as e:
+                executor.shutdown()
+                now = time.monotonic()
+                if start_deadline is None:
+                    start_deadline = now + fail_cfg.gang_start_timeout_s
+                    import sys
+
+                    print(f"train: gang start failed ({e}); waiting up "
+                          f"to {fail_cfg.gang_start_timeout_s:.0f}s for "
+                          "capacity", file=sys.stderr)
+                if now < start_deadline:
+                    time.sleep(1.0)
+                    continue
+                start_deadline = None
+                if failures_left != 0:
+                    failures_left -= 1
+                    continue
+                manager.wait_async()
+                return Result(metrics=history[-1] if history else {},
+                              checkpoint=latest_ckpt[0], error=str(e),
+                              metrics_history=history, path=trial_dir)
+            start_deadline = None
+            try:
+                if self._datasets:
+                    shards = self._shard_datasets(executor.worker_group)
+                    for rank, worker_shards in enumerate(shards):
+                        executor.worker_group.workers[
+                            rank].setup_session.remote(
+                            dataset_shards=worker_shards
+                        )
                 outcomes = executor.run(
                     self._train_fn, self._config, on_report=on_report,
                     loaded_checkpoint=latest_ckpt[0],

@@ -94,8 +94,17 @@ class WorkerGroup:
         worker_cls = remote(_TrainWorker)
         self.workers = []
         for rank in range(num_workers):
+            # max_concurrency=2: run_train_fn BLOCKS its executor slot
+            # for the whole training run; the second slot keeps
+            # drain_results/setup_session live so reports and async
+            # checkpoints stream out DURING training (with one slot they
+            # all queued behind the train loop and only landed at the
+            # end — fatal for preemption recovery, which restores from
+            # the last mid-run checkpoint). session.report/drain are
+            # lock-guarded for exactly this concurrency.
             actor = worker_cls.options(
                 num_cpus=resources.get("CPU", 1.0),
+                max_concurrency=2,
                 scheduling_strategy=PlacementGroupSchedulingStrategy(
                     placement_group=self._pg,
                     placement_group_bundle_index=rank,
