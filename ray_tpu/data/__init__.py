@@ -16,22 +16,31 @@ from .datasource import (
     BinaryDatasource,
     CSVDatasource,
     Datasource,
+    ImageFolderDatasource,
     JSONDatasource,
     NumpyDatasource,
     ParquetDatasource,
+    TFRecordDatasource,
     read_binary_files,
     read_csv,
     read_datasource,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_tfrecords,
 )
+from .random_access import RandomAccessDataset
 from .pipeline import DatasetPipeline
+from .stats import DatasetStats
 
 __all__ = [
     "BinaryDatasource", "Block", "BlockAccessor", "CSVDatasource", "Dataset",
-    "DatasetPipeline", "Datasource", "GroupedData", "JSONDatasource",
-    "NumpyDatasource", "ParquetDatasource", "from_items", "from_numpy",
+    "DatasetPipeline", "DatasetStats", "Datasource", "GroupedData",
+    "ImageFolderDatasource", "JSONDatasource",
+    "NumpyDatasource", "ParquetDatasource", "RandomAccessDataset",
+    "TFRecordDatasource", "from_items", "from_numpy",
     "from_pandas", "range", "read_binary_files", "read_csv",
-    "read_datasource", "read_json", "read_numpy", "read_parquet",
+    "read_datasource", "read_images", "read_json", "read_numpy",
+    "read_parquet", "read_tfrecords",
 ]

@@ -35,6 +35,7 @@ import numpy as np
 from ..core import get, put, remote, wait
 from ..core.object_ref import ObjectRef
 from .block import Block, BlockAccessor, build_blocks, concat_blocks, _key_of
+from .stats import DatasetStats, timed_block_task
 
 
 @dataclass(frozen=True)
@@ -71,6 +72,11 @@ def _fused_stages_task(stages, block):
     return block
 
 
+# (block, {wall_s, cpu_s, rows}) — meta rides back as a second return so
+# Dataset.stats() can report per-task timings with no extra task wave.
+_timed_fused_stages_task = timed_block_task(_fused_stages_task)
+
+
 class ExecutionPlan:
     """Input block refs + pending fused stages; executes once, caches.
 
@@ -83,6 +89,7 @@ class ExecutionPlan:
         self._input = list(input_blocks)
         self.stages = tuple(stages)
         self._executed: Optional[List[ObjectRef]] = None
+        self.stats: Optional[DatasetStats] = None
 
     def with_stage(self, stage: _Stage) -> "ExecutionPlan":
         if self._executed is not None:
@@ -96,10 +103,19 @@ class ExecutionPlan:
                 self._executed = list(self._input)
             else:
                 num_cpus = max(s.num_cpus for s in self.stages)
-                task = remote(_fused_stages_task).options(num_cpus=num_cpus)
+                task = remote(_timed_fused_stages_task).options(
+                    num_cpus=num_cpus, num_returns=2)
                 stages = self.stages
-                self._executed = [task.remote(stages, ref)
-                                  for ref in self._input]
+                blocks, metas = [], []
+                for ref in self._input:
+                    b, m = task.remote(stages, ref)
+                    blocks.append(b)
+                    metas.append(m)
+                self._executed = blocks
+                if self.stats is not None:
+                    name = "map[" + "+".join(s.kind for s in stages) + "]"
+                    self.stats.record_stage(name, metas,
+                                            watch_refs=blocks)
         return self._executed
 
     def num_blocks(self) -> int:
@@ -115,10 +131,13 @@ def _map_block_task(fn, block, batch_format):
 class Dataset:
     def __init__(self, block_refs: Optional[List[ObjectRef]] = None,
                  parallelism: Optional[int] = None,
-                 _plan: Optional[ExecutionPlan] = None):
+                 _plan: Optional[ExecutionPlan] = None,
+                 _stats: Optional[DatasetStats] = None):
         self._plan = _plan if _plan is not None else ExecutionPlan(
             list(block_refs or []))
         self._parallelism = parallelism or self._plan.num_blocks()
+        self._stats = _stats if _stats is not None else DatasetStats()
+        self._plan.stats = self._stats
 
     @property
     def _blocks(self) -> List[ObjectRef]:
@@ -126,8 +145,27 @@ class Dataset:
         return self._plan.execute()
 
     def _with_stage(self, stage: _Stage) -> "Dataset":
+        # Child stats with a parent link (NOT shared): sibling branches
+        # off one dataset must not pollute each other's stage lists.
         return Dataset(_plan=self._plan.with_stage(stage),
-                       parallelism=self._parallelism)
+                       parallelism=self._parallelism,
+                       _stats=DatasetStats(parent=self._stats))
+
+    def _derive(self, blocks: List[ObjectRef]) -> "Dataset":
+        """New dataset downstream of this one, stats lineage preserved."""
+        return Dataset(blocks, _stats=DatasetStats(parent=self._stats))
+
+    def stats(self) -> DatasetStats:
+        """Execution statistics along this dataset's lineage (reference:
+        ``Dataset.stats()`` / ``data/_internal/stats.py``): per stage,
+        the task count, per-task wall/cpu sums, and rows produced.
+        Triggers execution (stats describe work actually done). Stage
+        wall times are stamped by ready-watchers on the stage outputs;
+        per-task wall/cpu aggregates are measured inside the tasks."""
+        blocks = self._blocks
+        if blocks:
+            wait(blocks, num_returns=len(blocks), timeout=300)
+        return self._stats
 
     # ------------------------------------------------------------ metadata
     def num_blocks(self) -> int:
@@ -150,13 +188,6 @@ class Dataset:
         if rows and isinstance(rows[0], dict):
             return {k: type(v).__name__ for k, v in rows[0].items()}
         return type(rows[0]).__name__ if rows else None
-
-    def stats(self) -> Dict[str, Any]:
-        return {
-            "num_blocks": self.num_blocks(),
-            "count": self.count(),
-            "size_bytes": self.size_bytes(),
-        }
 
     # ------------------------------------------------------------ transforms
     def map(self, fn: Callable) -> "Dataset":
@@ -220,34 +251,63 @@ class Dataset:
         ]
         if num_blocks == 1:
             pieces = [[p] for p in pieces]
-        return Dataset([
+        out = self._derive([
             merge_task.remote(*[pieces[i][j]
                                 for i in range(len(self._blocks))])
             for j in range(num_blocks)
         ])
+        out._stats.record_stage(f"repartition[{num_blocks}]",
+                                watch_refs=out._plan._input)
+        return out
 
-    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        """Reference: dataset.py:806 — map-stage split + reduce-stage merge
-        (push-based shuffle simplified to two task waves)."""
-        n = max(1, len(self._blocks))
+    def random_shuffle(self, seed: Optional[int] = None, *,
+                       merge_factor: int = 8) -> "Dataset":
+        """PUSH-BASED shuffle (reference:
+        ``data/_internal/push_based_shuffle.py:330,363``): map tasks are
+        submitted in ROUNDS of ``merge_factor`` blocks, and each round's
+        per-reducer pieces merge into a partial as soon as that round's
+        maps finish — merging PIPELINES with later rounds' maps instead
+        of a global two-wave barrier, and bounds the in-flight piece
+        count at merge_factor x reducers (vs blocks x reducers). The
+        final reduce permutes each reducer's merged partials."""
+        blocks = self._blocks
+        m = len(blocks)
+        n = max(1, m)
         split_task = remote(_shuffle_split_task)
+        partial_task = remote(_concat_blocks_task)
         reduce_task = remote(_shuffle_reduce_task).options(num_returns=1)
         seeds = _random.Random(seed)
-        pieces = [
-            split_task.options(num_returns=n).remote(
-                ref, n, seeds.randrange(2**31)
-            )
-            for ref in self._blocks
+        round_partials: List[List[ObjectRef]] = []  # [round][reducer]
+        for r0 in range(0, m, max(1, merge_factor)):
+            round_blocks = blocks[r0:r0 + max(1, merge_factor)]
+            pieces = [
+                split_task.options(num_returns=n).remote(
+                    ref, n, seeds.randrange(2**31))
+                for ref in round_blocks
+            ]
+            if n == 1:
+                pieces = [[p] for p in pieces]
+            if len(round_blocks) == 1:
+                # single map in the round: its pieces ARE the partials
+                round_partials.append([pieces[0][j] for j in range(n)])
+                continue
+            round_partials.append([
+                partial_task.remote(*[pieces[i][j]
+                                      for i in range(len(round_blocks))])
+                for j in range(n)
+            ])
+        new_blocks = [
+            reduce_task.remote(
+                seeds.randrange(2**31),
+                *[round_partials[r][j]
+                  for r in range(len(round_partials))])
+            for j in range(n)
         ]
-        if n == 1:
-            pieces = [[p] for p in pieces]
-        new_blocks = []
-        for j in range(n):
-            shard_refs = [pieces[i][j] for i in range(len(self._blocks))]
-            new_blocks.append(
-                reduce_task.remote(seeds.randrange(2**31), *shard_refs)
-            )
-        return Dataset(new_blocks)
+        out = self._derive(new_blocks)
+        out._stats.record_stage(
+            f"random_shuffle[push,rounds={len(round_partials)},"
+            f"reducers={n}]", watch_refs=new_blocks)
+        return out
 
     def sort(self, key=None, descending: bool = False) -> "Dataset":
         """Distributed SAMPLE-SORT (reference: ``_internal/sort.py``):
@@ -260,7 +320,11 @@ class Dataset:
         n = max(1, len(self._blocks))
         if n == 1:
             task = remote(_sort_block_task)
-            return Dataset([task.remote(self._blocks[0], key, descending)])
+            out = self._derive(
+                [task.remote(self._blocks[0], key, descending)])
+            out._stats.record_stage("sort[1]",
+                                    watch_refs=out._plan._input)
+            return out
         sample_task = remote(_sample_keys_task)
         samples: List[Any] = []
         for part in get([sample_task.remote(ref, key, 16)
@@ -285,7 +349,17 @@ class Dataset:
         ]
         if descending:
             blocks.reverse()
-        return Dataset(blocks)
+        out = self._derive(blocks)
+        out._stats.record_stage(f"sort[sample,partitions={n}]",
+                                watch_refs=blocks)
+        return out
+
+    def to_random_access(self, key: str, num_workers: int = 2):
+        """Random-access view: sorted by ``key``, range-partitioned over
+        serving actors (reference: ``random_access_dataset.py:23``)."""
+        from .random_access import RandomAccessDataset
+
+        return RandomAccessDataset(self, key, num_workers)
 
     def _block_row_counts(self) -> List[int]:
         task = remote(_count_rows_task)
@@ -631,10 +705,18 @@ def _group_map_task(key, fn, part):
 # -- shuffle task bodies -----------------------------------------------------
 
 def _shuffle_split_task(block, n, seed):
+    """Always returns exactly n pieces (build_blocks caps at the row
+    count, so short blocks pad with empties to honor num_returns=n —
+    same contract as _range_split_task)."""
     rows = BlockAccessor.for_block(block).to_rows()
     rng = _random.Random(seed)
     rng.shuffle(rows)
-    return tuple(build_blocks(rows, n)) if n > 1 else rows
+    if n <= 1:
+        return rows
+    pieces = [list(p) for p in build_blocks(rows, n)]
+    while len(pieces) < n:
+        pieces.append([])
+    return tuple(pieces)
 
 
 def _shuffle_reduce_task(seed, *shards):

@@ -1,0 +1,107 @@
+"""Random-access view of a sorted Dataset.
+
+Reference analog: ``python/ray/data/random_access_dataset.py:23``
+(``RandomAccessDataset``): the dataset is sorted by a key column and
+range-partitioned across serving ACTORS; each actor pins its partitions
+in memory with a per-partition sorted key index, so ``get_async(key)``
+is one actor RPC + binary search. ``multiget`` batches keys per actor.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Dict, List, Optional
+
+from ..core import get, remote
+from .block import BlockAccessor, _key_of
+
+
+class _RangeServer:
+    """Actor: holds a contiguous sorted key range of the dataset."""
+
+    def __init__(self, key: str, *blocks):
+        # blocks ride as TOP-LEVEL args so the runtime materializes the
+        # ObjectRefs before __init__ runs (refs nested in a list would
+        # arrive unresolved).
+        rows: List[Any] = []
+        for b in blocks:
+            rows.extend(BlockAccessor.for_block(b).to_rows())
+        rows.sort(key=lambda r: _key_of(r, key))
+        self._rows = rows
+        self._keys = [_key_of(r, key) for r in rows]
+
+    def bounds(self):
+        return (self._keys[0], self._keys[-1]) if self._keys else None
+
+    def lookup(self, k):
+        i = bisect.bisect_left(self._keys, k)
+        if i < len(self._keys) and self._keys[i] == k:
+            return self._rows[i]
+        return None
+
+    def multiget(self, keys: List[Any]) -> List[Optional[Any]]:
+        return [self.lookup(k) for k in keys]
+
+    def num_rows(self) -> int:
+        return len(self._rows)
+
+
+class RandomAccessDataset:
+    """Build with ``Dataset.to_random_access(key, num_workers)``."""
+
+    def __init__(self, dataset, key: str, num_workers: int = 2):
+        sorted_ds = dataset.sort(key)
+        blocks = sorted_ds._blocks
+        num_workers = max(1, min(num_workers, len(blocks)))
+        per = -(-len(blocks) // num_workers)  # ceil
+        server_cls = remote(_RangeServer)
+        self._key = key
+        self._servers = []
+        self._bounds: List[Any] = []  # lower bound of each server's range
+        for w in range(num_workers):
+            shard = blocks[w * per:(w + 1) * per]
+            if not shard:
+                break
+            self._servers.append(server_cls.remote(key, *shard))
+        bounds = get([s.bounds.remote() for s in self._servers], timeout=120)
+        # Drop empty servers; record each range's lower bound for routing.
+        keep = [(s, b) for s, b in zip(self._servers, bounds)
+                if b is not None]
+        self._servers = [s for s, _ in keep]
+        self._bounds = [b[0] for _, b in keep]
+
+    def _route(self, k) -> int:
+        i = bisect.bisect_right(self._bounds, k) - 1
+        return max(0, i)
+
+    def get_async(self, key_value):
+        """ObjectRef of the row with this key (None when absent)."""
+        if not self._servers:  # empty dataset: every lookup misses
+            from ..core import put
+
+            return put(None)
+        return self._servers[self._route(key_value)].lookup.remote(
+            key_value)
+
+    def multiget(self, keys: List[Any]) -> List[Optional[Any]]:
+        """Batched lookup: one RPC per touched server."""
+        if not self._servers:
+            return [None] * len(keys)
+        per_server: Dict[int, List[int]] = {}
+        for pos, k in enumerate(keys):
+            per_server.setdefault(self._route(k), []).append(pos)
+        out: List[Optional[Any]] = [None] * len(keys)
+        refs = []
+        for sid, positions in per_server.items():
+            refs.append((positions, self._servers[sid].multiget.remote(
+                [keys[p] for p in positions])))
+        for positions, ref in refs:
+            for p, value in zip(positions, get(ref, timeout=60)):
+                out[p] = value
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        counts = get([s.num_rows.remote() for s in self._servers],
+                     timeout=60)
+        return {"num_servers": len(self._servers),
+                "rows_per_server": counts}

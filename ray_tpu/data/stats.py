@@ -1,0 +1,138 @@
+"""Dataset execution statistics.
+
+Reference analog: ``python/ray/data/_internal/stats.py`` —
+``DatasetStats`` records per-stage wall time, per-task execution time,
+and row counts so a user can see where a pipeline spends its time
+(``Dataset.stats()``). Task-level wall/cpu/rows are measured INSIDE the
+task and shipped back as a second return value (an extra small object,
+no extra task wave); driver-side wall measures submit→all-ready.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class StageStats:
+    """One executed stage (a wave of tasks over blocks)."""
+
+    name: str
+    submitted_at: float
+    wall_s: Optional[float] = None  # driver: submit -> all outputs ready
+    task_metas: List[Any] = field(default_factory=list)  # refs or dicts
+    _resolved: Optional[List[Dict]] = None
+
+    def _metas(self) -> List[Dict]:
+        if self._resolved is None:
+            from ..core import get
+
+            refs = [m for m in self.task_metas if not isinstance(m, dict)]
+            inline = [m for m in self.task_metas if isinstance(m, dict)]
+            fetched = get(refs, timeout=120) if refs else []
+            self._resolved = inline + list(fetched)
+        return self._resolved
+
+    def summary(self) -> Dict[str, Any]:
+        metas = self._metas()
+        out: Dict[str, Any] = {
+            "stage": self.name,
+            "num_tasks": len(metas),
+            "wall_s": round(self.wall_s, 4) if self.wall_s else None,
+        }
+        if metas:
+            walls = [m["wall_s"] for m in metas]
+            out.update({
+                "task_wall_s_sum": round(sum(walls), 4),
+                "task_wall_s_max": round(max(walls), 4),
+                "task_cpu_s_sum": round(
+                    sum(m.get("cpu_s", 0.0) for m in metas), 4),
+                "rows_out": sum(m.get("rows", 0) for m in metas),
+            })
+        return out
+
+
+class DatasetStats:
+    """Accumulates stage stats along a dataset's lineage."""
+
+    def __init__(self, parent: Optional["DatasetStats"] = None):
+        self._stages: List[StageStats] = []
+        self._parent = parent
+
+    def record_stage(self, name: str, task_metas: Optional[List] = None,
+                     watch_refs: Optional[List] = None) -> StageStats:
+        """``watch_refs``: output refs whose readiness stamps the stage's
+        wall time (submit → last output ready) via zero-cost status
+        watchers — accurate even when stats() is read much later."""
+        st = StageStats(name=name, submitted_at=time.perf_counter(),
+                        task_metas=list(task_metas or []))
+        self._stages.append(st)
+        if watch_refs:
+            from ..core import on_ref_ready
+
+            remaining = [len(watch_refs)]
+
+            def one_ready():
+                remaining[0] -= 1
+                if remaining[0] == 0 and st.wall_s is None:
+                    st.wall_s = time.perf_counter() - st.submitted_at
+
+            for ref in watch_refs:
+                try:
+                    on_ref_ready(ref, one_ready)
+                except Exception:  # noqa: BLE001 — stats must not fail ops
+                    break
+        return st
+
+    def all_stages(self) -> List[StageStats]:
+        stages: List[StageStats] = []
+        if self._parent is not None:
+            stages.extend(self._parent.all_stages())
+        stages.extend(self._stages)
+        return stages
+
+    def summary(self) -> List[Dict[str, Any]]:
+        return [s.summary() for s in self.all_stages()]
+
+    def __repr__(self) -> str:
+        lines = ["DatasetStats:"]
+        for s in self.summary():
+            extra = ""
+            if "rows_out" in s:
+                extra = (f", tasks wall sum {s['task_wall_s_sum']}s max "
+                         f"{s['task_wall_s_max']}s, cpu "
+                         f"{s['task_cpu_s_sum']}s, rows {s['rows_out']}")
+            lines.append(
+                f"  {s['stage']}: {s['num_tasks']} tasks"
+                + (f", wall {s['wall_s']}s" if s["wall_s"] else "")
+                + extra)
+        return "\n".join(lines)
+
+
+def timed_block_task(fn):
+    """Wrap a block task so it ALSO returns {wall_s, cpu_s, rows} — used
+    with num_returns=2 so the meta rides back as its own tiny object."""
+
+    def run(*args, **kwargs):
+        t0 = time.perf_counter()
+        c0 = time.process_time()
+        block = fn(*args, **kwargs)
+        meta = {
+            "wall_s": time.perf_counter() - t0,
+            "cpu_s": time.process_time() - c0,
+            "rows": _safe_rows(block),
+        }
+        return block, meta
+
+    return run
+
+
+def _safe_rows(block) -> int:
+    try:
+        from .block import BlockAccessor
+
+        return BlockAccessor.for_block(block).num_rows()
+    except Exception:  # noqa: BLE001 — stats must never fail a task
+        return 0

@@ -1,0 +1,161 @@
+"""Round-4 Data features: Dataset.stats(), push-based shuffle,
+image/TFRecord datasources, random-access dataset."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import data as rtd
+
+
+@pytest.fixture
+def rt_shared_small():
+    rt.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    rt.shutdown()
+
+
+def test_stats_records_map_stages(rt_shared_small):
+    ds = rtd.from_items(list(range(1000)), parallelism=4)
+    ds = ds.map_batches(lambda b: {"x": np.asarray(b["value"]) * 2})
+    ds = ds.map_batches(lambda b: {"x": b["x"] + 1})
+    assert ds.count() == 1000
+    stats = ds.stats()
+    summary = stats.summary()
+    # Two chained map_batches FUSE into one stage of 4 tasks.
+    map_stages = [s for s in summary if s["stage"].startswith("map[")]
+    assert len(map_stages) == 1, summary
+    st = map_stages[0]
+    assert st["num_tasks"] == 4
+    assert st["rows_out"] == 1000
+    assert st["task_wall_s_sum"] > 0
+    assert st["task_cpu_s_sum"] >= 0
+    assert "DatasetStats" in repr(stats)
+
+
+def test_stats_lineage_spans_shuffle(rt_shared_small):
+    ds = rtd.from_items(list(range(200)), parallelism=4)
+    ds = ds.map_batches(lambda b: {"value": np.asarray(b["value"])})
+    out = ds.random_shuffle(seed=7)
+    out.count()
+    names = [s["stage"] for s in out.stats().summary()]
+    assert any(n.startswith("map[") for n in names)
+    assert any(n.startswith("random_shuffle[push") for n in names), names
+
+
+def test_push_shuffle_correct_and_rounded(rt_shared_small):
+    items = list(range(3000))
+    ds = rtd.from_items(items, parallelism=12)
+    # merge_factor 4 -> 3 rounds of partial merges.
+    out = ds.random_shuffle(seed=3, merge_factor=4)
+    rows = out.take_all() if hasattr(out, "take_all") else out.take(10**6)
+    vals = sorted(r["item"] if isinstance(r, dict) else r for r in rows)
+    assert vals == items
+    # and it actually permuted
+    flat = [r["item"] if isinstance(r, dict) else r
+            for r in (out.take(100))]
+    assert flat != list(range(len(flat)))
+    names = [s["stage"] for s in out.stats().summary()]
+    assert "random_shuffle[push,rounds=3,reducers=12]" in names
+
+
+def test_push_shuffle_short_blocks(rt_shared_small):
+    # Blocks with fewer rows than the reducer count must pad with empty
+    # pieces (num_returns contract), not crash.
+    ds = rtd.from_items(list(range(4)), parallelism=4)
+    out = ds.random_shuffle(seed=1)
+    assert sorted(out.take_all()) == [0, 1, 2, 3]
+
+
+def test_stats_sibling_branches_isolated(rt_shared_small):
+    ds = rtd.from_items(list(range(100)), parallelism=2)
+    a = ds.map(lambda r: r + 1)
+    b = ds.map(lambda r: r * 2)
+    a.count()
+    b.count()
+    a_maps = [s for s in a.stats().summary()
+              if s["stage"].startswith("map[")]
+    assert len(a_maps) == 1, a_maps  # b's execution must not leak into a
+
+
+def test_crc32c_fallback_matches_library():
+    google_crc32c = pytest.importorskip("google_crc32c")
+    from ray_tpu.data.datasource import _crc32c_table
+
+    def pure(data: bytes) -> int:
+        table = _crc32c_table()
+        crc = 0xFFFFFFFF
+        for byte in data:
+            crc = (crc >> 8) ^ table[(crc ^ byte) & 0xFF]
+        return crc ^ 0xFFFFFFFF
+
+    for payload in (b"", b"a", b"hello world", bytes(range(256)) * 17):
+        assert pure(payload) == int(google_crc32c.value(payload))
+
+
+def test_random_access_empty_dataset(rt_shared_small):
+    ds = rtd.from_items([{"id": 1}], parallelism=1).filter(
+        lambda r: False)
+    ra = ds.to_random_access("id")
+    assert rt.get(ra.get_async(5)) is None
+    assert ra.multiget([1, 2]) == [None, None]
+
+
+def test_tfrecord_roundtrip(rt_shared_small, tmp_path):
+    payloads = [b"alpha", b"beta" * 100, b"\x00\xffbin"]
+    ds = rtd.from_items([{"bytes": p} for p in payloads], parallelism=1)
+    src = rtd.TFRecordDatasource()
+    src.write(ds, str(tmp_path), prefix="rec")
+    back = rtd.read_tfrecords(str(tmp_path))
+    got = [r["bytes"] for r in back.take(10)]
+    assert got == payloads
+
+
+def test_tfrecord_readable_by_tensorflow(rt_shared_small, tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    payloads = [b"one", b"two"]
+    ds = rtd.from_items([{"bytes": p} for p in payloads], parallelism=1)
+    rtd.TFRecordDatasource().write(ds, str(tmp_path), prefix="tfr")
+    files = sorted(
+        os.path.join(str(tmp_path), f) for f in os.listdir(str(tmp_path)))
+    got = [bytes(x.numpy()) for x in tf.data.TFRecordDataset(files)]
+    assert got == payloads
+
+
+def test_image_folder_datasource(rt_shared_small, tmp_path):
+    from PIL import Image
+
+    for label in ("cat", "dog"):
+        d = tmp_path / label
+        d.mkdir()
+        for i in range(2):
+            arr = np.full((4, 5, 3), 10 * (i + 1), np.uint8)
+            Image.fromarray(arr).save(d / f"img{i}.png")
+    ds = rtd.read_images(str(tmp_path))
+    rows = ds.take(10)
+    assert len(rows) == 4
+    labels = sorted({r["label"] for r in rows})
+    assert labels == ["cat", "dog"]
+    assert rows[0]["image"].shape == (4, 5, 3)
+    assert rows[0]["image"].dtype == np.uint8
+
+
+def test_random_access_dataset(rt_shared_small):
+    rows = [{"id": i, "payload": i * i} for i in range(500)]
+    import random
+
+    random.Random(0).shuffle(rows)
+    ds = rtd.from_items(rows, parallelism=8)
+    ra = ds.to_random_access("id", num_workers=3)
+    assert rt.get(ra.get_async(123))["payload"] == 123 * 123
+    assert ra.multiget([0, 499, 250, 999999]) == [
+        {"id": 0, "payload": 0},
+        {"id": 499, "payload": 499 * 499},
+        {"id": 250, "payload": 250 * 250},
+        None,
+    ]
+    stats = ra.stats()
+    assert sum(stats["rows_per_server"]) == 500
+    assert stats["num_servers"] == 3
