@@ -26,7 +26,8 @@ from .offline import (
 )
 from .ondevice import JAX_ENVS, JaxEnv, OnDevicePPO, jax_atari_sim, \
     jax_cartpole
-from .policy import JaxPolicy, make_network
+from .catalog import MODEL_DEFAULTS, get_network, register_custom_model
+from .policy import JaxPolicy, Network, make_network
 from .ppo import PPO, PPOConfig
 from .replay_buffers import (
     MultiAgentReplayBuffer,
@@ -50,7 +51,8 @@ __all__ = [
     "WeightedImportanceSampling",
     "Algorithm", "AlgorithmConfig", "AtariSim", "DQN", "DQNConfig",
     "FastCartPole", "FastPendulum", "GymVectorEnv", "Impala",
-    "ImpalaConfig", "JAX_ENVS", "SAC", "SACConfig",
+    "ImpalaConfig", "JAX_ENVS", "MODEL_DEFAULTS", "Network", "SAC",
+    "SACConfig", "get_network", "register_custom_model",
     "JaxEnv", "JaxPolicy", "MultiAgentReplayBuffer", "OnDevicePPO", "PPO",
     "PPOConfig", "PrioritizedReplayBuffer", "ReplayBuffer",
     "ReservoirReplayBuffer", "RolloutWorker", "SampleBatch", "VectorEnv",

@@ -36,6 +36,9 @@ class AlgorithmConfig:
         self.policy_hidden: tuple = (64, 64)
         # "auto" = conv (Nature CNN) for [H,W,C] frame obs, mlp otherwise
         self.policy_network: str = "auto"
+        # Catalog model config (reference: config.model / MODEL_DEFAULTS):
+        # fcnet_hiddens, use_lstm, lstm_cell_size, custom_model, ...
+        self.model: Optional[Dict[str, Any]] = None
         self.extra: Dict[str, Any] = {}
 
     def environment(self, env: Any = None, **kwargs) -> "AlgorithmConfig":
@@ -59,6 +62,7 @@ class AlgorithmConfig:
     def training(self, lr: Optional[float] = None,
                  gamma: Optional[float] = None,
                  train_batch_size: Optional[int] = None,
+                 model: Optional[Dict[str, Any]] = None,
                  **kwargs) -> "AlgorithmConfig":
         if lr is not None:
             self.lr = lr
@@ -66,6 +70,8 @@ class AlgorithmConfig:
             self.gamma = gamma
         if train_batch_size is not None:
             self.train_batch_size = train_batch_size
+        if model is not None:
+            self.model = model
         self.extra.update(kwargs)
         return self
 
@@ -98,7 +104,8 @@ class WorkerSet:
         self.local_worker = worker_cls(
             config.env, config.num_envs_per_worker,
             {"hidden": config.policy_hidden,
-             "network": config.policy_network}, seed=config.seed,
+             "network": config.policy_network,
+             "model": config.model}, seed=config.seed,
         )
         self.remote_workers: List[Any] = []
         if config.num_rollout_workers > 0:
@@ -107,7 +114,8 @@ class WorkerSet:
                 remote_cls.options(num_cpus=1).remote(
                     config.env, config.num_envs_per_worker,
                     {"hidden": config.policy_hidden,
-                     "network": config.policy_network},
+                     "network": config.policy_network,
+                     "model": config.model},
                     seed=config.seed, worker_index=i + 1,
                 )
                 for i in range(config.num_rollout_workers)

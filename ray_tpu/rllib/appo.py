@@ -68,6 +68,10 @@ class APPO(Impala):
         import optax
 
         super().setup(config)
+        if self.workers.local_worker.policy.net.is_recurrent:
+            raise NotImplementedError(
+                "APPO does not support recurrent models "
+                "(model={'use_lstm': True}); use PPO")
         gamma = config.gamma
         vf_coeff, ent_coeff = config.vf_coeff, config.entropy_coeff
         clip_param = config.clip_param

@@ -1,0 +1,138 @@
+"""Model catalog: pick/build policy networks by observation space and
+model config.
+
+Reference analog: ``rllib/models/catalog.py`` (``ModelCatalog``) — the
+component that turns (obs space, action space, model config) into a
+network: conv stacks for image observations, MLPs for vectors, an LSTM
+wrapper when ``use_lstm`` is set, and a custom-model registry
+(``register_custom_model`` + ``model_config["custom_model"]``).
+JAX re-design: networks are pure ``(init, apply)`` pairs over param
+pytrees (``policy.Network``); recurrent networks add
+``initial_state``/``apply_state``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.common import truncated_normal
+from .policy import (
+    Network,
+    forward_mlp,
+    init_conv_policy,
+    init_mlp_policy,
+    make_network,
+)
+
+# Reference: models/catalog.py MODEL_DEFAULTS (subset that applies here).
+MODEL_DEFAULTS: Dict = {
+    "custom_model": None,
+    "fcnet_hiddens": (64, 64),
+    "use_lstm": False,
+    "lstm_cell_size": 64,
+    # None -> Nature-CNN for rank-3 obs; "mlp"/"conv" force a family.
+    "network": "auto",
+}
+
+_CUSTOM_MODELS: Dict[str, Callable] = {}
+
+
+def register_custom_model(name: str, factory: Callable) -> None:
+    """``factory(obs_shape, num_actions, model_config) -> Network``
+    (reference: ModelCatalog.register_custom_model)."""
+    _CUSTOM_MODELS[name] = factory
+
+
+def init_lstm_policy(key, obs_dim: int, num_actions: int,
+                     hidden: Sequence[int] = (64,),
+                     cell: int = 64) -> Dict:
+    """MLP trunk -> LSTM cell -> separate pi/vf heads (reference:
+    catalog.py use_lstm wrapping, models/torch/recurrent_net.py)."""
+    params = {}
+    sizes = [obs_dim] + list(hidden)
+    keys = jax.random.split(key, len(sizes) + 3)
+    for i in range(len(sizes) - 1):
+        std = float(np.sqrt(2.0 / sizes[i]))
+        params[f"t{i}_w"] = truncated_normal(
+            keys[i], (sizes[i], sizes[i + 1]), stddev=std)
+        params[f"t{i}_b"] = jnp.zeros((sizes[i + 1],))
+    feat = sizes[-1]
+    std = float(np.sqrt(1.0 / (feat + cell)))
+    # One fused kernel for the 4 gates (i, f, g, o).
+    params["lstm_w"] = truncated_normal(
+        keys[-3], (feat + cell, 4 * cell), stddev=std)
+    params["lstm_b"] = jnp.zeros((4 * cell,))
+    params["pi_w"] = truncated_normal(keys[-2], (cell, num_actions),
+                                      stddev=0.01)
+    params["pi_b"] = jnp.zeros((num_actions,))
+    params["vf_w"] = truncated_normal(keys[-1], (cell, 1), stddev=1.0)
+    params["vf_b"] = jnp.zeros((1,))
+    return params
+
+
+def lstm_initial_state(batch: int, cell: int) -> Tuple[jnp.ndarray, ...]:
+    return (jnp.zeros((batch, cell)), jnp.zeros((batch, cell)))
+
+
+def forward_lstm(params: Dict, obs: jnp.ndarray, state):
+    """-> (logits [B, A], values [B], new_state)."""
+    x = obs.astype(jnp.float32).reshape(obs.shape[0], -1)
+    i = 0
+    while f"t{i}_w" in params:
+        x = jnp.tanh(x @ params[f"t{i}_w"] + params[f"t{i}_b"])
+        i += 1
+    h, c = state
+    gates = jnp.concatenate([x, h], axis=-1) @ params["lstm_w"] + \
+        params["lstm_b"]
+    gi, gf, gg, go = jnp.split(gates, 4, axis=-1)
+    c = jax.nn.sigmoid(gf + 1.0) * c + jax.nn.sigmoid(gi) * jnp.tanh(gg)
+    h = jax.nn.sigmoid(go) * jnp.tanh(c)
+    logits = h @ params["pi_w"] + params["pi_b"]
+    values = (h @ params["vf_w"] + params["vf_b"])[..., 0]
+    return logits, values, (h, c)
+
+
+def get_network(obs_shape: Tuple[int, ...], num_actions: int,
+                model_config: Optional[Dict] = None) -> Network:
+    """The catalog entry point (reference: ModelCatalog.get_model_v2):
+    custom registry first, then LSTM wrapper, then conv-vs-mlp by
+    observation rank."""
+    cfg = dict(MODEL_DEFAULTS)
+    cfg.update(model_config or {})
+    custom = cfg.get("custom_model")
+    if custom is not None:
+        if custom not in _CUSTOM_MODELS:
+            raise ValueError(
+                f"custom model {custom!r} is not registered "
+                f"(known: {sorted(_CUSTOM_MODELS)})")
+        return _CUSTOM_MODELS[custom](obs_shape, num_actions, cfg)
+    if cfg.get("use_lstm"):
+        obs_dim = int(np.prod(obs_shape))
+        hidden = tuple(cfg["fcnet_hiddens"])
+        cell = int(cfg["lstm_cell_size"])
+        return Network(
+            kind="lstm",
+            init=lambda key: init_lstm_policy(
+                key, obs_dim, num_actions, hidden, cell),
+            apply=None,
+            initial_state=lambda batch: lstm_initial_state(batch, cell),
+            apply_state=forward_lstm,
+        )
+    return make_network(obs_shape, num_actions, cfg.get("network", "auto"),
+                        tuple(cfg["fcnet_hiddens"]))
+
+
+__all__ = [
+    "MODEL_DEFAULTS",
+    "get_network",
+    "init_conv_policy",
+    "init_lstm_policy",
+    "init_mlp_policy",
+    "forward_lstm",
+    "forward_mlp",
+    "register_custom_model",
+]

@@ -121,6 +121,10 @@ class Impala(Algorithm):
         import optax
 
         super().setup(config)
+        if self.workers.local_worker.policy.net.is_recurrent:
+            raise NotImplementedError(
+                "IMPALA does not support recurrent models "
+                "(model={'use_lstm': True}); use PPO")
         self.params = self.workers.local_worker.policy.params
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(config.grad_clip),

@@ -128,10 +128,21 @@ def forward_conv(params: Dict, obs: jnp.ndarray
 
 @dataclass(frozen=True)
 class Network:
-    """A policy network: pure (init, apply) over a param pytree."""
+    """A policy network: pure (init, apply) over a param pytree.
+
+    Recurrent networks leave ``apply`` None and provide
+    ``initial_state(batch)`` + ``apply_state(params, obs, state) ->
+    (logits, values, new_state)`` instead (catalog use_lstm path)."""
     kind: str
     init: Callable[[Any], Dict]
-    apply: Callable[[Dict, jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]]
+    apply: Optional[Callable[
+        [Dict, jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]]] = None
+    initial_state: Optional[Callable[[int], Any]] = None
+    apply_state: Optional[Callable] = None
+
+    @property
+    def is_recurrent(self) -> bool:
+        return self.apply_state is not None
 
 
 def make_network(obs_shape: Tuple[int, ...], num_actions: int,
@@ -173,29 +184,83 @@ def sample_actions(apply_fn, params, obs, key, deterministic: bool):
 
 
 class JaxPolicy:
-    """Discrete-action actor-critic policy."""
+    """Discrete-action actor-critic policy.
+
+    ``model_config`` routes through the catalog (conv/mlp/lstm/custom,
+    reference: ModelCatalog.get_model_v2); the legacy
+    ``network``/``hidden`` args remain as shorthand. Recurrent nets keep
+    their state here across ``compute_actions`` calls; rollout workers
+    call ``observe_dones`` so finished sub-envs reset their slot."""
 
     def __init__(self, obs_shape: Tuple[int, ...], num_actions: int,
                  hidden: Sequence[int] = (64, 64), seed: int = 0,
-                 network: str = "auto"):
+                 network: str = "auto",
+                 model_config: Optional[Dict] = None):
         self.obs_dim = int(np.prod(obs_shape))
         self.num_actions = num_actions
-        self.net = make_network(obs_shape, num_actions, network, hidden)
+        if model_config is not None:
+            from .catalog import get_network
+
+            self.net = get_network(obs_shape, num_actions, model_config)
+        else:
+            self.net = make_network(obs_shape, num_actions, network,
+                                    hidden)
         key = jax.random.PRNGKey(seed)
         self.params = self.net.init(key)
         self._key = jax.random.PRNGKey(seed + 1)
-        self._sample = jax.jit(
-            functools.partial(sample_actions, self.net.apply),
-            static_argnums=(3,))
+        self._state = None
+        if self.net.is_recurrent:
+            apply_state = self.net.apply_state
+
+            def sample_rec(params, obs, state, key, deterministic):
+                logits, values, new_state = apply_state(params, obs,
+                                                        state)
+                if deterministic:
+                    actions = jnp.argmax(logits, axis=-1)
+                else:
+                    actions = jax.random.categorical(key, logits, axis=-1)
+                logp = jax.nn.log_softmax(logits)[
+                    jnp.arange(actions.shape[0]), actions]
+                return actions, logp, values, new_state
+
+            self._sample_rec = jax.jit(sample_rec, static_argnums=(4,))
+        else:
+            self._sample = jax.jit(
+                functools.partial(sample_actions, self.net.apply),
+                static_argnums=(3,))
 
     def compute_actions(self, obs: np.ndarray, deterministic: bool = False):
         """Reference: Policy.compute_actions (:411)."""
         obs = np.asarray(obs)
         self._key, sub = jax.random.split(self._key)
+        if self.net.is_recurrent:
+            # One-off queries with a different batch size (e.g. a
+            # batch-1 eval between rollouts) run on a FRESH zero state
+            # and do NOT clobber the tracked rollout state.
+            tracked = self._state
+            one_off = tracked is not None and \
+                tracked[0].shape[0] != len(obs)
+            state = (self.net.initial_state(len(obs))
+                     if tracked is None or one_off else tracked)
+            actions, logp, values, new_state = self._sample_rec(
+                self.params, jnp.asarray(obs), state, sub,
+                deterministic)
+            if not one_off:
+                self._state = new_state
+            return (np.asarray(actions), np.asarray(logp),
+                    np.asarray(values))
         actions, logp, values = self._sample(
             self.params, jnp.asarray(obs), sub, deterministic
         )
         return (np.asarray(actions), np.asarray(logp), np.asarray(values))
+
+    def observe_dones(self, dones: np.ndarray) -> None:
+        """Reset recurrent state for finished sub-envs (no-op for
+        feedforward nets)."""
+        if self._state is None or not np.any(dones):
+            return
+        mask = jnp.asarray(~np.asarray(dones, bool), jnp.float32)[:, None]
+        self._state = tuple(s * mask for s in self._state)
 
     def get_weights(self) -> Dict:
         return jax.tree.map(np.asarray, self.params)

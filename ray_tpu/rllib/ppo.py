@@ -23,6 +23,7 @@ from .policy import forward_mlp
 from .sample_batch import (
     ACTIONS,
     ADVANTAGES,
+    DONES,
     LOGPS,
     OBS,
     VALUE_TARGETS,
@@ -30,6 +31,8 @@ from .sample_batch import (
     compute_gae,
     flatten_time_major,
 )
+
+STATE_IN = "state_in"  # [S, N, cell]: recurrent state at fragment start
 
 
 class PPOConfig(AlgorithmConfig):
@@ -62,11 +65,19 @@ class PPOConfig(AlgorithmConfig):
 
 
 def ppo_loss(params, batch, clip_param, vf_clip, vf_coeff, ent_coeff,
-             apply_fn=forward_mlp):
-    logits, values = apply_fn(params, batch[OBS])
+             apply_fn=forward_mlp, batch_apply=None):
+    """``batch_apply(params, batch) -> (logits, values)`` supersedes
+    ``apply_fn`` when set (recurrent nets need DONES from the batch to
+    reset state mid-sequence); arrays may carry any leading dims
+    ([B] flat or [T, B_seq] sequence-major)."""
+    if batch_apply is not None:
+        logits, values = batch_apply(params, batch)
+    else:
+        logits, values = apply_fn(params, batch[OBS])
     logp_all = jax.nn.log_softmax(logits)
     actions = batch[ACTIONS].astype(jnp.int32)
-    logp = jnp.take_along_axis(logp_all, actions[:, None], axis=-1)[:, 0]
+    logp = jnp.take_along_axis(logp_all, actions[..., None],
+                               axis=-1)[..., 0]
     ratio = jnp.exp(logp - batch[LOGPS])
     adv = batch[ADVANTAGES]
     adv = (adv - adv.mean()) / (adv.std() + 1e-8)
@@ -87,37 +98,31 @@ def ppo_loss(params, batch, clip_param, vf_clip, vf_coeff, ent_coeff,
     }
 
 
-def build_ppo_update(config: PPOConfig, optimizer, apply_fn=forward_mlp):
-    """One compiled program: epochs x minibatches of SGD.
-
-    The minibatch schedule is a static reshape + permutation consumed by
-    nested ``lax.scan`` — no per-minibatch dispatch from the host.
-    """
+def _build_sgd_scan(config: PPOConfig, optimizer, make_minibatches,
+                    num_items, loss_kwargs_fn):
+    """Shared SGD driver: epochs x minibatches as nested ``lax.scan`` —
+    no per-minibatch dispatch from the host. The flat and recurrent
+    updates differ only in how a permutation slices the batch into
+    minibatches (``make_minibatches``) and how the loss applies the
+    network (``loss_kwargs_fn``)."""
     clip, vfc, vco, eco = (config.clip_param, config.vf_clip_param,
                            config.vf_loss_coeff, config.entropy_coeff)
-    mb_size = config.sgd_minibatch_size
     epochs = config.num_sgd_iter
 
     @jax.jit
     def update(params, opt_state, batch, rng):
-        n = batch[OBS].shape[0]
-        num_mb = max(1, n // mb_size)
-        usable = num_mb * mb_size
+        n = num_items(batch)
 
         def epoch_body(carry, epoch_rng):
             params, opt_state = carry
-            perm = jax.random.permutation(epoch_rng, n)[:usable]
-            shuffled = {k: v[perm] for k, v in batch.items()}
-            mbs = {
-                k: v.reshape((num_mb, mb_size) + v.shape[1:])
-                for k, v in shuffled.items()
-            }
+            perm = jax.random.permutation(epoch_rng, n)
+            mbs = make_minibatches(batch, perm)
 
             def mb_body(carry, mb):
                 params, opt_state = carry
                 (loss, aux), grads = jax.value_and_grad(
                     ppo_loss, has_aux=True
-                )(params, mb, clip, vfc, vco, eco, apply_fn)
+                )(params, mb, clip, vfc, vco, eco, **loss_kwargs_fn())
                 updates, opt_state = optimizer.update(grads, opt_state,
                                                       params)
                 params = optax.apply_updates(params, updates)
@@ -140,6 +145,77 @@ def build_ppo_update(config: PPOConfig, optimizer, apply_fn=forward_mlp):
     return update
 
 
+def build_ppo_update(config: PPOConfig, optimizer, apply_fn=forward_mlp):
+    """Flat-batch PPO update: minibatches are row slices of [B, ...]."""
+    mb_size = config.sgd_minibatch_size
+
+    def make_minibatches(batch, perm):
+        n = batch[OBS].shape[0]
+        num_mb = max(1, n // mb_size)
+        usable = num_mb * mb_size
+        shuffled = {k: v[perm[:usable]] for k, v in batch.items()}
+        return {
+            k: v.reshape((num_mb, mb_size) + v.shape[1:])
+            for k, v in shuffled.items()
+        }
+
+    return _build_sgd_scan(
+        config, optimizer, make_minibatches,
+        num_items=lambda batch: batch[OBS].shape[0],
+        loss_kwargs_fn=lambda: {"apply_fn": apply_fn})
+
+
+def build_ppo_update_recurrent(config: PPOConfig, optimizer, net):
+    """Recurrent PPO: batch arrays are SEQUENCE-MAJOR [T, N, ...] plus
+    STATE_IN [S, N, cell]; minibatches are whole sequences (N axis), and
+    the loss recomputes logits by scanning the recurrent cell over T
+    from the SAME state the behavior policy had at fragment start
+    (shipped by the rollout worker), resetting at episode boundaries
+    (reference: state_in handling in
+    ``rllib/policy/rnn_sequencing.py``)."""
+    apply_state = net.apply_state
+    mb_size = config.sgd_minibatch_size
+
+    def seq_apply(params, batch):
+        obs, dones = batch[OBS], batch[DONES]
+
+        def step(state, xs):
+            obs_t, done_t = xs
+            logits, values, new_state = apply_state(params, obs_t, state)
+            mask = (1.0 - done_t.astype(jnp.float32))[:, None]
+            new_state = tuple(s * mask for s in new_state)
+            return new_state, (logits, values)
+
+        state0 = tuple(batch[STATE_IN][i]
+                       for i in range(batch[STATE_IN].shape[0]))
+        _, (logits, values) = jax.lax.scan(step, state0, (obs, dones))
+        return logits, values  # [T, n_seq, A], [T, n_seq]
+
+    def make_minibatches(batch, perm):
+        t = batch[OBS].shape[0]
+        n = batch[OBS].shape[1]
+        mb = max(1, min(max(1, mb_size // t), n))
+        num_mb = max(1, n // mb)
+        usable = num_mb * mb
+        out = {}
+        for k, v in batch.items():
+            # Sequence axis: 1 for [T, N, ...] arrays AND [S, N, cell]
+            # state; reshape the seq axis into (num_mb, mb) and move
+            # num_mb to the front for the scan.
+            sliced = v[:, perm[:usable]]
+            lead = sliced.shape[0]
+            out[k] = jnp.moveaxis(
+                sliced.reshape((lead, num_mb, mb) + sliced.shape[2:]),
+                1, 0)
+        return out
+
+    return _build_sgd_scan(
+        config, optimizer, make_minibatches,
+        num_items=lambda batch: batch[OBS].shape[1],
+        loss_kwargs_fn=lambda: {"apply_fn": None,
+                                "batch_apply": seq_apply})
+
+
 class PPO(Algorithm):
     def setup(self, config: PPOConfig) -> None:
         super().setup(config)
@@ -152,9 +228,14 @@ class PPO(Algorithm):
             jnp.asarray, self.workers.local_worker.policy.params
         )
         self.opt_state = self.optimizer.init(self.params)
-        self._update = build_ppo_update(
-            config, self.optimizer,
-            self.workers.local_worker.policy.net.apply)
+        net = self.workers.local_worker.policy.net
+        self._recurrent = net.is_recurrent
+        if self._recurrent:
+            self._update = build_ppo_update_recurrent(
+                config, self.optimizer, net)
+        else:
+            self._update = build_ppo_update(config, self.optimizer,
+                                            net.apply)
         self._rng = jax.random.PRNGKey(config.seed)
         self.workers.sync_weights(jax.tree.map(np.asarray, self.params))
 
@@ -167,15 +248,29 @@ class PPO(Algorithm):
             last_values = frag.pop("last_values")
             frag.pop("final_obs", None)  # IMPALA-only bootstrap column
             frag = compute_gae(frag, last_values, cfg.gamma, cfg.lambda_)
-            processed.append(flatten_time_major(frag))
-        train_batch = SampleBatch.concat_samples(processed)
-        steps = train_batch.count
+            if not self._recurrent:
+                frag = flatten_time_major(frag)
+            processed.append(frag)
+        if self._recurrent:
+            # Sequence-major [T, N] (+ STATE_IN [S, N, cell]): concat
+            # fragments along the env axis.
+            keys = (OBS, ACTIONS, LOGPS, ADVANTAGES, VALUE_TARGETS,
+                    DONES, STATE_IN)
+            device_batch = {
+                k: jnp.asarray(np.concatenate(
+                    [np.asarray(f[k]) for f in processed], axis=1))
+                for k in keys
+            }
+            steps = int(device_batch[OBS].shape[0]
+                        * device_batch[OBS].shape[1])
+        else:
+            train_batch = SampleBatch.concat_samples(processed)
+            steps = train_batch.count
+            device_batch = {
+                k: jnp.asarray(v) for k, v in train_batch.items()
+                if k in (OBS, ACTIONS, LOGPS, ADVANTAGES, VALUE_TARGETS)
+            }
         self._timesteps_total += steps
-
-        device_batch = {
-            k: jnp.asarray(v) for k, v in train_batch.items()
-            if k in (OBS, ACTIONS, LOGPS, ADVANTAGES, VALUE_TARGETS)
-        }
         self._rng, sub = jax.random.split(self._rng)
         self.params, self.opt_state, metrics = self._update(
             self.params, self.opt_state, device_batch, sub

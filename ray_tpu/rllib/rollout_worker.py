@@ -54,6 +54,7 @@ class RolloutWorker:
             self.env.observation_space_shape, self.env.num_actions,
             hidden=cfg.get("hidden", (64, 64)), seed=seed,
             network=cfg.get("network", "auto"),
+            model_config=cfg.get("model"),
         )
 
     def apply(self, fn) -> Any:
@@ -69,6 +70,18 @@ class RolloutWorker:
     def sample(self, rollout_length: int = 128) -> SampleBatch:
         """Collect a [T, N, ...] fragment; auto-resetting envs."""
         n = self.env.num_envs
+        state_in = None
+        if getattr(getattr(self.policy, "net", None), "is_recurrent",
+                   False):
+            # Ship the behavior policy's hidden state at fragment start
+            # so the learner's sequence scan starts from the SAME state
+            # (reference: state_in in rnn_sequencing.py) — zero-state
+            # recompute would skew the importance ratio on fragments
+            # starting mid-episode.
+            state = self.policy._state
+            if state is None or state[0].shape[0] != n:
+                state = self.policy.net.initial_state(n)
+            state_in = np.stack([np.asarray(s) for s in state])
         # Preserve the env's obs dtype: forward_conv keys its /255
         # normalization on uint8, so coercing frames to float32 here would
         # make the training batch see a DIFFERENT function than the one
@@ -94,13 +107,25 @@ class RolloutWorker:
             for i in np.nonzero(dones)[0]:
                 self._completed.append(float(self._episode_rewards[i]))
                 self._episode_rewards[i] = 0.0
+            # Recurrent policies reset finished sub-envs' state slots.
+            observe = getattr(self.policy, "observe_dones", None)
+            if observe is not None:
+                observe(dones)
             self._obs = next_obs
-        # Bootstrap values for the final observation.
+        # Bootstrap values for the final observation — side-effect-free
+        # for recurrent policies: the next fragment will feed this same
+        # observation again, so advancing the hidden state here would
+        # make the LSTM see every fragment-boundary obs twice.
+        saved_state = getattr(self.policy, "_state", None)
         _, _, last_values = self.policy.compute_actions(self._obs)
+        if saved_state is not None:
+            self.policy._state = saved_state
         batch = SampleBatch({
             OBS: obs_buf, ACTIONS: act_buf, LOGPS: logp_buf,
             VF_PREDS: vf_buf, REWARDS: rew_buf, DONES: done_buf,
         })
+        if state_in is not None:
+            batch["state_in"] = state_in
         batch["last_values"] = np.asarray(last_values, np.float32)
         # Final observation [N, obs]: V-trace bootstraps V(x_T) under the
         # *learner's* policy (IMPALA), so ship the state, not just the
