@@ -19,10 +19,19 @@ from .autoscaler import (
     ResourceDemandScheduler,
     StandardAutoscaler,
 )
+from .command_runner import (
+    CommandRunner,
+    CommandRunnerError,
+    NodeUpdater,
+    SSHCommandRunner,
+    SubprocessCommandRunner,
+)
 from .providers import FakeNodeProvider, LocalNodeProvider, NodeProvider
 
 __all__ = [
-    "AutoscalerConfig", "FakeNodeProvider", "LoadMetrics",
-    "LocalNodeProvider", "NodeProvider", "NodeType",
-    "ResourceDemandScheduler", "StandardAutoscaler",
+    "AutoscalerConfig", "CommandRunner", "CommandRunnerError",
+    "FakeNodeProvider", "LoadMetrics",
+    "LocalNodeProvider", "NodeProvider", "NodeType", "NodeUpdater",
+    "ResourceDemandScheduler", "SSHCommandRunner", "StandardAutoscaler",
+    "SubprocessCommandRunner",
 ]

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from .providers import NodeProvider
 
@@ -163,12 +163,77 @@ class ResourceDemandScheduler:
 
 
 class StandardAutoscaler:
-    """The update loop (reference: autoscaler.py:162 StandardAutoscaler)."""
+    """The update loop (reference: autoscaler.py:162 StandardAutoscaler).
 
-    def __init__(self, provider: NodeProvider, config: AutoscalerConfig):
+    ``updater_factory(instance) -> NodeUpdater`` (optional) is the
+    bring-up path: every node the provider launches is configured and
+    joined to the cluster by its updater on a background thread
+    (reference: the NodeUpdater threads spawned by
+    ``autoscaler.py update_if_needed``)."""
+
+    def __init__(self, provider: NodeProvider, config: AutoscalerConfig,
+                 updater_factory=None):
         self.provider = provider
         self.config = config
         self.scheduler = ResourceDemandScheduler(config)
+        self.updater_factory = updater_factory
+        self.max_bringup_failures = 3
+        self._updated: set = set()
+        self._updater_threads: Dict[str, Any] = {}
+        self._bringup_failures: Dict[str, int] = {}
+        self.updater_errors: Dict[str, str] = {}
+
+    def _maybe_update_nodes(self, nodes) -> None:
+        if self.updater_factory is None:
+            return
+        import threading
+
+        for inst in nodes:
+            if inst.node_id in self._updated:
+                continue
+            if getattr(inst, "tags", None) and \
+                    inst.tags.get("rt-configured"):
+                # Provider-persisted marker: survives autoscaler
+                # restarts, so already-joined hosts are not re-setup
+                # (providers without label persistence re-run bring-up
+                # after a restart — start commands must be idempotent).
+                self._updated.add(inst.node_id)
+                continue
+            self._updated.add(inst.node_id)
+            updater = self.updater_factory(inst)
+            if updater is None:
+                continue
+
+            def run(node_id=inst.node_id, updater=updater):
+                try:
+                    updater.update()
+                except Exception as e:  # noqa: BLE001 — recorded, visible
+                    self.updater_errors[node_id] = repr(e)
+                    n = self._bringup_failures.get(node_id, 0) + 1
+                    self._bringup_failures[node_id] = n
+                    if n >= self.max_bringup_failures:
+                        # Give up: a phantom node that never joined
+                        # satisfies demand counts without capacity.
+                        try:
+                            self.provider.terminate_node(node_id)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    else:
+                        # Retry on the next tick.
+                        self._updated.discard(node_id)
+                    return
+                self.updater_errors.pop(node_id, None)
+                label = getattr(self.provider, "label_node", None)
+                if label is not None:
+                    try:
+                        label(node_id, {"rt-configured": "1"})
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            t = threading.Thread(target=run, daemon=True,
+                                 name=f"rt-updater-{inst.node_id[:8]}")
+            self._updater_threads[inst.node_id] = t
+            t.start()
 
     def update(self, metrics: LoadMetrics) -> Dict[str, int]:
         """One reconcile tick: terminate idle, launch for demand."""
@@ -197,4 +262,6 @@ class StandardAutoscaler:
         to_launch = self.scheduler.get_nodes_to_launch(metrics, by_type)
         for node_type, count in to_launch.items():
             self.provider.create_node(node_type, count)
+        # Bring-up: configure + join any launched-but-unconfigured node.
+        self._maybe_update_nodes(self.provider.non_terminated_nodes())
         return to_launch

@@ -1,0 +1,179 @@
+"""Command runners + node updater: the bring-up path for launched hosts.
+
+Reference analog: ``python/ray/autoscaler/_private/command_runner.py``
+(``SSHCommandRunner``: run/run_rsync_up with retries and ssh options)
+and ``updater.py`` (``NodeUpdater``: wait-for-ready, sync files, run
+setup commands, start the node process). Without this layer a provider
+can launch a host but nothing can configure it — the gap that left the
+TPU-pod provider mock-only in round 3.
+
+Two runners: ``SSHCommandRunner`` for real remote hosts and
+``SubprocessCommandRunner`` (localhost exec) so the updater lifecycle is
+fully testable without sshd — the same split as the reference's
+``SSHCommandRunner`` vs ``FakeCommandRunner``/local node provider.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class CommandRunnerError(RuntimeError):
+    def __init__(self, cmd: str, returncode: int, output: str):
+        super().__init__(
+            f"command failed (rc={returncode}): {cmd}\n{output[-2000:]}")
+        self.cmd = cmd
+        self.returncode = returncode
+        self.output = output
+
+
+class CommandRunner:
+    """Run commands / sync files on one node."""
+
+    def run(self, cmd: str, timeout: float = 120.0,
+            env: Optional[Dict[str, str]] = None) -> str:
+        raise NotImplementedError
+
+    def run_detached(self, cmd: str,
+                     env: Optional[Dict[str, str]] = None) -> None:
+        """Launch a long-running process that survives this runner."""
+        raise NotImplementedError
+
+    def sync_up(self, local_path: str, remote_path: str) -> None:
+        raise NotImplementedError
+
+    def ready(self, timeout: float = 60.0) -> bool:
+        """Node reachable and able to execute commands."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                self.run("true", timeout=10)
+                return True
+            except Exception:  # noqa: BLE001 — keep probing
+                time.sleep(1.0)
+        return False
+
+
+class SubprocessCommandRunner(CommandRunner):
+    """Localhost execution — the testable updater path (reference:
+    the local/fake command runner used by the local node provider)."""
+
+    def __init__(self, cwd: Optional[str] = None):
+        self.cwd = cwd
+
+    def run(self, cmd: str, timeout: float = 120.0,
+            env: Optional[Dict[str, str]] = None) -> str:
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        proc = subprocess.run(
+            ["/bin/sh", "-c", cmd], cwd=self.cwd, env=full_env,
+            capture_output=True, text=True, timeout=timeout)
+        if proc.returncode != 0:
+            raise CommandRunnerError(cmd, proc.returncode,
+                                     proc.stdout + proc.stderr)
+        return proc.stdout
+
+    def run_detached(self, cmd: str,
+                     env: Optional[Dict[str, str]] = None) -> None:
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        subprocess.Popen(
+            ["/bin/sh", "-c", cmd], cwd=self.cwd, env=full_env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+
+    def sync_up(self, local_path: str, remote_path: str) -> None:
+        os.makedirs(os.path.dirname(remote_path) or ".", exist_ok=True)
+        subprocess.run(["cp", "-r", local_path, remote_path], check=True)
+
+
+class SSHCommandRunner(CommandRunner):
+    """SSH execution (reference: command_runner.py SSHCommandRunner —
+    same ssh option set: batch mode, no host-key prompts, connection
+    timeout; rsync for file sync)."""
+
+    SSH_OPTS = [
+        "-o", "ConnectTimeout=10s",
+        "-o", "StrictHostKeyChecking=no",
+        "-o", "UserKnownHostsFile=/dev/null",
+        "-o", "BatchMode=yes",
+        "-o", "LogLevel=ERROR",
+    ]
+
+    def __init__(self, host: str, user: Optional[str] = None,
+                 ssh_key: Optional[str] = None, port: int = 22):
+        self.host = host
+        self.user = user
+        self.ssh_key = ssh_key
+        self.port = port
+
+    def _target(self) -> str:
+        return f"{self.user}@{self.host}" if self.user else self.host
+
+    def _ssh_base(self) -> List[str]:
+        base = ["ssh"] + list(self.SSH_OPTS) + ["-p", str(self.port)]
+        if self.ssh_key:
+            base += ["-i", self.ssh_key]
+        return base
+
+    def run(self, cmd: str, timeout: float = 120.0,
+            env: Optional[Dict[str, str]] = None) -> str:
+        exports = "".join(
+            f"export {k}={shlex.quote(v)}; " for k, v in (env or {}).items())
+        argv = self._ssh_base() + [self._target(), exports + cmd]
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=timeout)
+        if proc.returncode != 0:
+            raise CommandRunnerError(cmd, proc.returncode,
+                                     proc.stdout + proc.stderr)
+        return proc.stdout
+
+    def run_detached(self, cmd: str,
+                     env: Optional[Dict[str, str]] = None) -> None:
+        exports = "".join(
+            f"export {k}={shlex.quote(v)}; " for k, v in (env or {}).items())
+        # nohup + setsid so the process survives the ssh session.
+        self.run(f"setsid nohup sh -c {shlex.quote(exports + cmd)} "
+                 f">/tmp/rt_node.log 2>&1 & echo started", timeout=30)
+
+    def sync_up(self, local_path: str, remote_path: str) -> None:
+        ssh_cmd = " ".join(self._ssh_base())
+        subprocess.run(
+            ["rsync", "-az", "-e", ssh_cmd, local_path,
+             f"{self._target()}:{remote_path}"],
+            check=True, timeout=300)
+
+
+@dataclass
+class NodeUpdater:
+    """Drive a launched host from bare to cluster member (reference:
+    updater.py NodeUpdater lifecycle: wait_ready → sync → setup →
+    start): waits for the runner, syncs ``file_mounts``, runs
+    ``setup_commands``, then launches ``rt start --address=<head>``
+    detached."""
+
+    runner: CommandRunner
+    head_address: str
+    file_mounts: Dict[str, str] = field(default_factory=dict)
+    setup_commands: List[str] = field(default_factory=list)
+    start_command: Optional[str] = None
+    env: Dict[str, str] = field(default_factory=dict)
+    num_workers: int = 2
+
+    def update(self, ready_timeout: float = 120.0) -> None:
+        if not self.runner.ready(timeout=ready_timeout):
+            raise TimeoutError("node never became reachable")
+        for local, remote in self.file_mounts.items():
+            self.runner.sync_up(local, remote)
+        for cmd in self.setup_commands:
+            self.runner.run(cmd, timeout=600)
+        start = self.start_command or (
+            f"python -m ray_tpu.scripts.cli start "
+            f"--address={self.head_address} "
+            f"--num-workers={self.num_workers}")
+        self.runner.run_detached(start, env=self.env)

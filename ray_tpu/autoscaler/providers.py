@@ -59,6 +59,14 @@ class FakeNodeProvider(NodeProvider):
             self.create_calls.append((node_type, count))
         return ids
 
+    def label_node(self, node_id: str, tags: Dict[str, str]) -> None:
+        """Persist bring-up markers on the instance (reference: the
+        node status tags the autoscaler sets via the provider)."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node.tags.update(tags)
+
     def terminate_node(self, node_id: str) -> None:
         with self._lock:
             node = self._nodes.get(node_id)

@@ -241,7 +241,8 @@ class _NativePubsub(Pubsub):
     Messages are pickled on publish and unpickled in the subscriber
     callback wrapper; frames that fail to unpickle are daemon-internal
     (e.g. its health checker's ``DEAD:<id>`` notices) and are dropped
-    here — the liveness sync thread consumes those via ``list_nodes``.
+    here — the control store's own raw subscription consumes those
+    (see ``start_health_check``).
     """
 
     def __init__(self, client):
@@ -350,26 +351,52 @@ class NativeBackedControlStore(GlobalControlStore):
         super().mark_node_dead(node_id, reason)
 
     def start_health_check(self, period_s: float, timeout_beats: int) -> None:
-        """Detection runs in the daemon; a sync thread applies its
-        verdicts to the Python node table (which publishes NODE events
-        through the normal path)."""
+        """Detection runs in the daemon; its verdicts STREAM back over
+        the push pubsub channel (the daemon publishes ``DEAD:<id>`` on
+        ``NODE`` the moment a heartbeat expires — reference:
+        ``ray_syncer.h:88`` push-based state sync, not interval polls),
+        with a slow list_nodes poll kept as the missed-push fallback."""
         self._client.start_health_check(period_s, timeout_beats)
 
+        def apply_native_death(node_id_bin: bytes, how: str) -> None:
+            with self._lock:
+                node = next(
+                    (n for n in self.nodes.values()
+                     if n.node_id.binary() == node_id_bin and n.alive),
+                    None)
+            if node is not None:
+                super(NativeBackedControlStore, self).mark_node_dead(
+                    node.node_id, f"heartbeat timeout ({how})")
+
+        def on_node_push(payload: bytes) -> None:
+            if payload.startswith(b"DEAD:"):
+                apply_native_death(payload[len(b"DEAD:"):], "native push")
+
+        push_ok = True
+        try:
+            self._client.subscribe("NODE", on_node_push)
+        except Exception as e:  # noqa: BLE001 — degrade loudly
+            push_ok = False
+            import sys
+
+            print(f"gcs: NODE push subscription failed ({e!r}); "
+                  "falling back to polling at the detection period",
+                  file=sys.stderr)
+        # With the push active, polling is only a lost-frame fallback
+        # and runs much slower; without it, poll at the full rate so
+        # detection latency does not regress.
+        poll_period = max(period_s * 5, 2.0) if push_ok else period_s
+
         def sync_loop():
-            while not self._stop.wait(period_s):
+            while not self._stop.wait(poll_period):
                 try:
                     native_nodes = self._client.list_nodes()
                 except Exception:
                     continue  # transient daemon I/O error; keep syncing
-                by_id = {}
-                with self._lock:
-                    for node in self.nodes.values():
-                        by_id[node.node_id.binary()] = node
                 for entry in native_nodes:
-                    node = by_id.get(entry["node_id"])
-                    if node is not None and node.alive and not entry["alive"]:
-                        super(NativeBackedControlStore, self).mark_node_dead(
-                            node.node_id, "heartbeat timeout (native)")
+                    if not entry["alive"]:
+                        apply_native_death(entry["node_id"],
+                                           "native poll")
 
         self._sync_thread = threading.Thread(target=sync_loop, daemon=True,
                                              name="gcs-native-sync")

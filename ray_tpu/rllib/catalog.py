@@ -96,6 +96,72 @@ def forward_lstm(params: Dict, obs: jnp.ndarray, state):
     return logits, values, (h, c)
 
 
+def init_conv_lstm_policy(key, obs_shape: Tuple[int, ...],
+                          num_actions: int, cell: int = 64,
+                          dense: int = 256) -> Dict:
+    """Nature-CNN trunk -> dense -> LSTM cell -> pi/vf heads (the
+    catalog's vision+LSTM wrapping for image observations)."""
+    from .policy import _CONV_SPEC
+
+    h, w, c = obs_shape
+    keys = jax.random.split(key, 8)
+    params: Dict = {}
+    cin = c
+    for i, (cout, k, stride) in enumerate(_CONV_SPEC):
+        std = float(np.sqrt(2.0 / (k * k * cin)))
+        params[f"conv{i}_w"] = truncated_normal(
+            keys[i], (k, k, cin, cout), stddev=std)
+        params[f"conv{i}_b"] = jnp.zeros((cout,))
+        h = (h - k) // stride + 1
+        w = (w - k) // stride + 1
+        cin = cout
+    flat = h * w * cin
+    params["dense_w"] = truncated_normal(
+        keys[3], (flat, dense), stddev=float(np.sqrt(2.0 / flat)))
+    params["dense_b"] = jnp.zeros((dense,))
+    std = float(np.sqrt(1.0 / (dense + cell)))
+    params["lstm_w"] = truncated_normal(
+        keys[4], (dense + cell, 4 * cell), stddev=std)
+    params["lstm_b"] = jnp.zeros((4 * cell,))
+    params["pi_w"] = truncated_normal(keys[5], (cell, num_actions),
+                                      stddev=0.01)
+    params["pi_b"] = jnp.zeros((num_actions,))
+    params["vf_w"] = truncated_normal(keys[6], (cell, 1), stddev=1.0)
+    params["vf_b"] = jnp.zeros((1,))
+    return params
+
+
+def forward_conv_lstm(params: Dict, obs: jnp.ndarray, state):
+    """[B, H, W, C] frames (uint8 normalized like forward_conv) ->
+    (logits, values, new_state)."""
+    from .policy import _CONV_SPEC
+
+    x = obs.astype(jnp.float32)
+    if obs.dtype == jnp.uint8:
+        x = x / 255.0
+    x = x.astype(jnp.bfloat16)
+    for i, (_cout, _k, stride) in enumerate(_CONV_SPEC):
+        x = jax.lax.conv_general_dilated(
+            x, params[f"conv{i}_w"].astype(x.dtype),
+            window_strides=(stride, stride), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + params[f"conv{i}_b"].astype(x.dtype)
+        x = jax.nn.relu(x)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["dense_w"].astype(x.dtype)
+                    + params["dense_b"].astype(x.dtype))
+    x = x.astype(jnp.float32)
+    h, c = state
+    gates = jnp.concatenate([x, h], axis=-1) @ params["lstm_w"] + \
+        params["lstm_b"]
+    gi, gf, gg, go = jnp.split(gates, 4, axis=-1)
+    c = jax.nn.sigmoid(gf + 1.0) * c + jax.nn.sigmoid(gi) * jnp.tanh(gg)
+    h = jax.nn.sigmoid(go) * jnp.tanh(c)
+    logits = h @ params["pi_w"] + params["pi_b"]
+    values = (h @ params["vf_w"] + params["vf_b"])[..., 0]
+    return logits, values, (h, c)
+
+
 def get_network(obs_shape: Tuple[int, ...], num_actions: int,
                 model_config: Optional[Dict] = None) -> Network:
     """The catalog entry point (reference: ModelCatalog.get_model_v2):
@@ -105,15 +171,36 @@ def get_network(obs_shape: Tuple[int, ...], num_actions: int,
     cfg.update(model_config or {})
     custom = cfg.get("custom_model")
     if custom is not None:
+        if callable(custom):
+            # A factory passed directly survives pickling into remote
+            # rollout workers (the NAME registry is process-local:
+            # remote actors never ran the driver's register calls).
+            return custom(obs_shape, num_actions, cfg)
         if custom not in _CUSTOM_MODELS:
             raise ValueError(
                 f"custom model {custom!r} is not registered "
-                f"(known: {sorted(_CUSTOM_MODELS)})")
+                f"(known: {sorted(_CUSTOM_MODELS)}). With remote "
+                "rollout workers pass the factory CALLABLE as "
+                "custom_model — string registration is per-process")
         return _CUSTOM_MODELS[custom](obs_shape, num_actions, cfg)
     if cfg.get("use_lstm"):
+        cell = int(cfg["lstm_cell_size"])
+        if len(obs_shape) == 3:
+            # Image observations: conv trunk feeding the LSTM cell
+            # (reference: ModelCatalog wraps the vision network with
+            # the LSTM; a flattened-MLP trunk over raw [0,255] frames
+            # would saturate immediately).
+            return Network(
+                kind="conv_lstm",
+                init=lambda key: init_conv_lstm_policy(
+                    key, obs_shape, num_actions, cell),
+                apply=None,
+                initial_state=lambda batch: lstm_initial_state(batch,
+                                                               cell),
+                apply_state=forward_conv_lstm,
+            )
         obs_dim = int(np.prod(obs_shape))
         hidden = tuple(cfg["fcnet_hiddens"])
-        cell = int(cfg["lstm_cell_size"])
         return Network(
             kind="lstm",
             init=lambda key: init_lstm_policy(

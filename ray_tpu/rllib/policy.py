@@ -208,7 +208,11 @@ class JaxPolicy:
         key = jax.random.PRNGKey(seed)
         self.params = self.net.init(key)
         self._key = jax.random.PRNGKey(seed + 1)
-        self._state = None
+        # Recurrent state PER BATCH SIZE: the rollout loop (batch N) and
+        # one-off eval calls (batch 1) each carry their own memory —
+        # sharing one slot would either reset eval every step or let an
+        # eval call corrupt the rollout state via shape broadcasting.
+        self._states: Dict[int, Any] = {}
         if self.net.is_recurrent:
             apply_state = self.net.apply_state
 
@@ -234,19 +238,14 @@ class JaxPolicy:
         obs = np.asarray(obs)
         self._key, sub = jax.random.split(self._key)
         if self.net.is_recurrent:
-            # One-off queries with a different batch size (e.g. a
-            # batch-1 eval between rollouts) run on a FRESH zero state
-            # and do NOT clobber the tracked rollout state.
-            tracked = self._state
-            one_off = tracked is not None and \
-                tracked[0].shape[0] != len(obs)
-            state = (self.net.initial_state(len(obs))
-                     if tracked is None or one_off else tracked)
+            b = len(obs)
+            state = self._states.get(b)
+            if state is None:
+                state = self.net.initial_state(b)
             actions, logp, values, new_state = self._sample_rec(
                 self.params, jnp.asarray(obs), state, sub,
                 deterministic)
-            if not one_off:
-                self._state = new_state
+            self._states[b] = new_state
             return (np.asarray(actions), np.asarray(logp),
                     np.asarray(values))
         actions, logp, values = self._sample(
@@ -254,13 +253,27 @@ class JaxPolicy:
         )
         return (np.asarray(actions), np.asarray(logp), np.asarray(values))
 
+    def recurrent_state(self, batch: int):
+        """The carried state for this batch size (zeros if fresh);
+        None for feedforward nets."""
+        if not self.net.is_recurrent:
+            return None
+        state = self._states.get(batch)
+        return state if state is not None \
+            else self.net.initial_state(batch)
+
+    def set_recurrent_state(self, batch: int, state) -> None:
+        if self.net.is_recurrent:
+            self._states[batch] = state
+
     def observe_dones(self, dones: np.ndarray) -> None:
         """Reset recurrent state for finished sub-envs (no-op for
         feedforward nets)."""
-        if self._state is None or not np.any(dones):
+        state = self._states.get(len(dones))
+        if state is None or not np.any(dones):
             return
         mask = jnp.asarray(~np.asarray(dones, bool), jnp.float32)[:, None]
-        self._state = tuple(s * mask for s in self._state)
+        self._states[len(dones)] = tuple(s * mask for s in state)
 
     def get_weights(self) -> Dict:
         return jax.tree.map(np.asarray, self.params)

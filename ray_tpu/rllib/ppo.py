@@ -26,13 +26,12 @@ from .sample_batch import (
     DONES,
     LOGPS,
     OBS,
+    STATE_IN,
     VALUE_TARGETS,
     SampleBatch,
     compute_gae,
     flatten_time_major,
 )
-
-STATE_IN = "state_in"  # [S, N, cell]: recurrent state at fragment start
 
 
 class PPOConfig(AlgorithmConfig):

@@ -21,6 +21,7 @@ from .sample_batch import (
     LOGPS,
     OBS,
     REWARDS,
+    STATE_IN,
     VF_PREDS,
     SampleBatch,
 )
@@ -78,9 +79,7 @@ class RolloutWorker:
             # (reference: state_in in rnn_sequencing.py) — zero-state
             # recompute would skew the importance ratio on fragments
             # starting mid-episode.
-            state = self.policy._state
-            if state is None or state[0].shape[0] != n:
-                state = self.policy.net.initial_state(n)
+            state = self.policy.recurrent_state(n)
             state_in = np.stack([np.asarray(s) for s in state])
         # Preserve the env's obs dtype: forward_conv keys its /255
         # normalization on uint8, so coercing frames to float32 here would
@@ -116,16 +115,17 @@ class RolloutWorker:
         # for recurrent policies: the next fragment will feed this same
         # observation again, so advancing the hidden state here would
         # make the LSTM see every fragment-boundary obs twice.
-        saved_state = getattr(self.policy, "_state", None)
+        saved_state = (self.policy.recurrent_state(n)
+                       if state_in is not None else None)
         _, _, last_values = self.policy.compute_actions(self._obs)
         if saved_state is not None:
-            self.policy._state = saved_state
+            self.policy.set_recurrent_state(n, saved_state)
         batch = SampleBatch({
             OBS: obs_buf, ACTIONS: act_buf, LOGPS: logp_buf,
             VF_PREDS: vf_buf, REWARDS: rew_buf, DONES: done_buf,
         })
         if state_in is not None:
-            batch["state_in"] = state_in
+            batch[STATE_IN] = state_in
         batch["last_values"] = np.asarray(last_values, np.float32)
         # Final observation [N, obs]: V-trace bootstraps V(x_T) under the
         # *learner's* policy (IMPALA), so ship the state, not just the

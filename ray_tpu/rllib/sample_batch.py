@@ -16,6 +16,7 @@ OBS = "obs"
 ACTIONS = "actions"
 REWARDS = "rewards"
 DONES = "dones"
+STATE_IN = "state_in"  # [S, N, cell]: recurrent state at fragment start
 NEXT_OBS = "next_obs"
 LOGPS = "action_logp"
 VF_PREDS = "vf_preds"

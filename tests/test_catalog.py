@@ -20,6 +20,19 @@ def test_catalog_selects_by_obs_rank():
     assert get_network((84, 84, 4), 6).kind == "conv"
     assert get_network((84, 84, 4), 6, {"network": "mlp"}).kind == "mlp"
     assert get_network((4,), 2, {"use_lstm": True}).kind == "lstm"
+    # Image obs + use_lstm wraps the CONV trunk (a flattened MLP over
+    # raw frames would saturate) — reference ModelCatalog behavior.
+    net = get_network((36, 36, 2), 4, {"use_lstm": True,
+                                       "lstm_cell_size": 8})
+    assert net.kind == "conv_lstm"
+    import jax
+
+    params = net.init(jax.random.PRNGKey(0))
+    obs = np.zeros((3, 36, 36, 2), np.uint8)
+    logits, values, state = net.apply_state(params, obs,
+                                            net.initial_state(3))
+    assert logits.shape == (3, 4) and values.shape == (3,)
+    assert state[0].shape == (3, 8)
 
 
 def test_catalog_custom_model_registry():
@@ -62,12 +75,19 @@ def test_lstm_policy_state_reset_on_done():
     obs = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
     policy.compute_actions(obs)
     policy.compute_actions(obs)
-    h_before = np.asarray(policy._state[0])
+    h_before = np.asarray(policy.recurrent_state(3)[0])
     assert np.abs(h_before).sum() > 0
     policy.observe_dones(np.array([True, False, False]))
-    h_after = np.asarray(policy._state[0])
+    h_after = np.asarray(policy.recurrent_state(3)[0])
     np.testing.assert_allclose(h_after[0], 0.0)
     assert np.abs(h_after[1:]).sum() > 0
+    # A one-off eval call (batch 1) carries its OWN state and does not
+    # touch the rollout batch's state.
+    policy.compute_actions(obs[:1])
+    policy.compute_actions(obs[:1])
+    assert np.abs(np.asarray(policy.recurrent_state(1)[0])).sum() > 0
+    np.testing.assert_allclose(
+        np.asarray(policy.recurrent_state(3)[0]), h_after)
 
 
 def test_ppo_with_lstm_model_smoke():
