@@ -36,6 +36,12 @@ def make_session_log_dir(base: Optional[str] = None) -> str:
     return path
 
 
+def worker_log_path(log_dir: str, worker_id_hex: str, stream: str) -> str:
+    """Canonical per-worker capture file — the single source for the
+    naming convention (writers here, HTTP log tail in observability)."""
+    return os.path.join(log_dir, f"worker-{worker_id_hex[:8]}.{stream}")
+
+
 def redirect_worker_streams(worker_id_hex: str) -> None:
     """Called inside worker processes: stdout/stderr -> session log files.
 
@@ -47,10 +53,9 @@ def redirect_worker_streams(worker_id_hex: str) -> None:
         return
     try:
         os.makedirs(log_dir, exist_ok=True)
-        short = worker_id_hex[:8]
-        out = open(os.path.join(log_dir, f"worker-{short}.out"), "a",
+        out = open(worker_log_path(log_dir, worker_id_hex, "out"), "a",
                    buffering=1)
-        err = open(os.path.join(log_dir, f"worker-{short}.err"), "a",
+        err = open(worker_log_path(log_dir, worker_id_hex, "err"), "a",
                    buffering=1)
         sys.stdout.flush()
         sys.stderr.flush()

@@ -151,7 +151,23 @@ class Dashboard:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                # Drill-down routes: /api/task/<hex>, /api/logs/<worker>
+                # (reference: dashboard per-task pages + log proxying).
                 fn = routes.get(path)
+                if fn is None and path.startswith("/api/task/"):
+                    task_hex = path[len("/api/task/"):]
+                    fn = lambda: state_api.task_detail(task_hex)  # noqa: E731
+                if fn is None and path.startswith("/api/logs/"):
+                    from urllib.parse import parse_qs, urlparse
+
+                    worker = path[len("/api/logs/"):]
+                    try:
+                        n = int(parse_qs(urlparse(self.path).query).get(
+                            "n", ["200"])[0])
+                    except ValueError:
+                        n = 200
+                    n = max(1, min(10000, n))
+                    fn = lambda: state_api.worker_log_tail(worker, n)  # noqa: E731
                 if fn is None:
                     self.send_response(404)
                     self.end_headers()

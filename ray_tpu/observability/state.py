@@ -60,6 +60,81 @@ def list_tasks(filters: Optional[Dict[str, str]] = None,
     return out
 
 
+def task_detail(task_id_hex: str) -> Dict[str, Any]:
+    """Per-task drill-down (reference: dashboard task page): full spec
+    metadata, placement, retries, args, and return-object states."""
+    from ..core.ids import TaskID
+
+    rt = _head()
+    try:
+        task_id = TaskID.from_hex(task_id_hex)
+    except (ValueError, TypeError):
+        return {"error": f"invalid task id {task_id_hex!r}"}
+    with rt._lock:
+        rec = rt._tasks.get(task_id)
+    if rec is None:
+        return {"error": f"unknown task {task_id_hex}"}
+    spec = rec.spec
+    returns = []
+    with rt._lock:
+        for oid in spec.return_ids():
+            entry = rt._objects.get(oid)
+            returns.append({
+                "object_id": oid.hex(),
+                "status": entry.status if entry else None,
+            })
+    return {
+        "task_id": spec.task_id.hex(),
+        "name": spec.name or spec.method_name or "",
+        "type": spec.task_type.name,
+        "state": rec.state,
+        "resources": dict(spec.resources),
+        "strategy": spec.strategy.kind,
+        "node_id": rec.node.node_id.hex() if rec.node else None,
+        "worker_id": (rec.worker.worker_id.hex()
+                      if rec.worker else None),
+        "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+        "retries_left": rec.retries_left,
+        "max_retries": spec.max_retries,
+        "num_args": len(spec.arg_refs),
+        "arg_object_ids": [o.hex() for o in spec.arg_refs],
+        "returns": returns,
+    }
+
+
+def worker_log_tail(worker_id_prefix: str, n: int = 200
+                    ) -> Dict[str, Any]:
+    """Tail a worker's captured stdout/stderr over HTTP (reference:
+    dashboard log proxying via the log directory)."""
+    import os
+
+    from ..core.log_monitor import worker_log_path
+
+    rt = _head()
+    log_dir = getattr(rt, "session_log_dir", None)
+    if not log_dir or not os.path.isdir(log_dir):
+        return {"error": "worker log capture is not enabled"}
+    out: Dict[str, Any] = {"worker": worker_id_prefix[:8]}
+    for stream in ("out", "err"):
+        path = worker_log_path(log_dir, worker_id_prefix, stream)
+        if os.path.exists(path):
+            # Bounded read: seek a window near the end instead of
+            # loading a potentially huge capture file into memory.
+            window = max(64 * 1024, n * 512)
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - window))
+                tail = f.read().decode(errors="replace")
+            lines = tail.splitlines(keepends=True)
+            if size > window and lines:
+                lines = lines[1:]  # drop the partial first line
+            out[stream] = lines[-n:]
+        else:
+            out[stream] = None
+    return out
+
+
 def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:
     rt = _head()
     out = []

@@ -1,0 +1,221 @@
+// C++ unit tests for the shm arena store — compiled and run by
+// tests/test_native_unit.py with ASan/UBSan, and again with TSan for
+// the concurrent sections (SURVEY §4.5: the daemons' concurrency story
+// must not rest on Python end-to-end tests alone).
+//
+// Includes the store's translation unit directly: the C ABI is the
+// contract under test and the single-TU layout keeps the build one
+// g++ invocation.
+
+#include "../../ray_tpu/_native/shm_store.cc"
+
+#include <array>
+#include <atomic>
+#include <cassert>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <thread>
+#include <vector>
+
+#define CHECK(cond)                                                      \
+  do {                                                                   \
+    if (!(cond)) {                                                       \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,      \
+                   __LINE__, #cond);                                     \
+      std::exit(1);                                                      \
+    }                                                                    \
+  } while (0)
+
+namespace {
+
+std::string unique_name(const char* tag) {
+  return std::string("/rt_cc_test_") + tag + "_" +
+         std::to_string(::getpid());
+}
+
+void make_key(uint8_t* out, uint32_t i, uint32_t salt = 0) {
+  std::memset(out, 0, kKeySize);
+  std::memcpy(out, &i, sizeof(i));
+  std::memcpy(out + sizeof(i), &salt, sizeof(salt));
+}
+
+void test_put_get_delete_roundtrip() {
+  auto name = unique_name("basic");
+  void* h = rt_store_create(name.c_str(), 1 << 20);
+  CHECK(h != nullptr);
+  uint8_t key[kKeySize];
+  make_key(key, 1);
+  std::vector<uint8_t> payload(1000, 0xAB);
+  CHECK(rt_store_put(h, key, payload.data(), payload.size()) == 0);
+  CHECK(rt_store_put(h, key, payload.data(), payload.size()) == -1);
+  uint64_t size = 0;
+  const uint8_t* ptr = rt_store_get(h, key, &size);
+  CHECK(ptr != nullptr && size == payload.size());
+  CHECK(std::memcmp(ptr, payload.data(), size) == 0);
+  rt_store_release(h, key);
+  CHECK(rt_store_delete(h, key) == 0);
+  CHECK(rt_store_get(h, key, &size) == nullptr);
+  rt_store_close(h, 1);
+}
+
+void test_alloc_free_coalescing() {
+  auto name = unique_name("coalesce");
+  const uint64_t cap = 1 << 20;
+  void* h = rt_store_create(name.c_str(), cap);
+  uint64_t c0, used0, n0;
+  rt_store_stats(h, &c0, &used0, &n0);
+  CHECK(used0 == 0);
+  // Fill with many objects, free in interleaved order, then a single
+  // allocation spanning nearly the whole arena must succeed — proof
+  // the free list coalesced back to one extent.
+  std::vector<std::array<uint8_t, kKeySize>> keys(64);
+  std::vector<uint8_t> payload(8 * 1024, 1);
+  for (uint32_t i = 0; i < 64; i++) {
+    make_key(keys[i].data(), i, 7);
+    CHECK(rt_store_put(h, keys[i].data(), payload.data(),
+                       payload.size()) == 0);
+  }
+  for (uint32_t i = 0; i < 64; i += 2) rt_store_delete(h, keys[i].data());
+  for (uint32_t i = 1; i < 64; i += 2) rt_store_delete(h, keys[i].data());
+  uint64_t c1, used1, n1;
+  rt_store_stats(h, &c1, &used1, &n1);
+  CHECK(used1 == 0 && n1 == 0);
+  uint8_t big_key[kKeySize];
+  make_key(big_key, 9999);
+  std::vector<uint8_t> big(cap - 4096, 2);
+  CHECK(rt_store_put(h, big_key, big.data(), big.size()) == 0);
+  rt_store_close(h, 1);
+}
+
+void test_pin_deferred_free() {
+  auto name = unique_name("pin");
+  void* h = rt_store_create(name.c_str(), 1 << 20);
+  uint8_t key[kKeySize];
+  make_key(key, 5);
+  std::vector<uint8_t> payload(512, 0x5A);
+  CHECK(rt_store_put(h, key, payload.data(), payload.size()) == 0);
+  uint64_t size = 0;
+  const uint8_t* ptr = rt_store_get(h, key, &size);  // pin
+  CHECK(ptr != nullptr);
+  CHECK(rt_store_delete(h, key) == 1);  // deferred: reader still pinned
+  // The extent's bytes must remain intact while pinned.
+  CHECK(std::memcmp(ptr, payload.data(), size) == 0);
+  // New put under the same key must refuse while the old extent lives.
+  CHECK(rt_store_put(h, key, payload.data(), payload.size()) == -5);
+  rt_store_release(h, key);  // last pin -> extent actually freed
+  CHECK(rt_store_put(h, key, payload.data(), payload.size()) == 0);
+  rt_store_close(h, 1);
+}
+
+void test_create_seal_abort() {
+  auto name = unique_name("seal");
+  void* h = rt_store_create(name.c_str(), 1 << 20);
+  uint8_t key[kKeySize];
+  make_key(key, 11);
+  int32_t err = 0;
+  uint8_t* w = rt_store_create_object(h, key, 256, &err);
+  CHECK(w != nullptr && err == 0);
+  // Unsealed reservation blocks a second writer with -6.
+  uint8_t* w2 = rt_store_create_object(h, key, 256, &err);
+  CHECK(w2 == nullptr && err == -6);
+  std::memset(w, 0xCC, 256);
+  CHECK(rt_store_seal(h, key) == 0);
+  uint64_t size = 0;
+  const uint8_t* r = rt_store_get(h, key, &size);
+  CHECK(r != nullptr && size == 256 && r[0] == 0xCC);
+  rt_store_release(h, key);
+  // Abort path: reserve then abort frees the extent.
+  uint8_t key2[kKeySize];
+  make_key(key2, 12);
+  w = rt_store_create_object(h, key2, 128, &err);
+  CHECK(w != nullptr);
+  CHECK(rt_store_abort(h, key2) == 0);
+  CHECK(rt_store_get(h, key2, &size) == nullptr);
+  rt_store_close(h, 1);
+}
+
+void test_repair_after_torn_state() {
+  auto name = unique_name("repair");
+  const uint64_t cap = 1 << 20;
+  void* h = rt_store_create(name.c_str(), cap);
+  uint8_t survivor[kKeySize];
+  make_key(survivor, 21);
+  std::vector<uint8_t> payload(4096, 0x77);
+  CHECK(rt_store_put(h, survivor, payload.data(), payload.size()) == 0);
+  // Simulate a writer dying mid-allocation from a SECOND attachment
+  // (its exit leaves the mutex OWNER_DIED and the state torn).
+  void* h2 = rt_store_attach(name.c_str());
+  CHECK(h2 != nullptr);
+  uint8_t torn[kKeySize];
+  make_key(torn, 22);
+  std::thread([&] {
+    CHECK(rt_store_test_die_mid_alloc(h2, torn) == 0);
+    // Thread exits holding the robust mutex -> OWNER_DIED.
+  }).join();
+  // Next lock on the first handle repairs: survivor intact, torn slot
+  // tombstoned, free list rebuilt so a big allocation still works.
+  uint64_t size = 0;
+  const uint8_t* r = rt_store_get(h, survivor, &size);
+  CHECK(r != nullptr && size == payload.size());
+  CHECK(std::memcmp(r, payload.data(), size) == 0);
+  rt_store_release(h, survivor);
+  uint64_t c, used, n;
+  rt_store_stats(h, &c, &used, &n);
+  CHECK(n == 1);
+  uint8_t big_key[kKeySize];
+  make_key(big_key, 23);
+  std::vector<uint8_t> big(cap / 2, 3);
+  CHECK(rt_store_put(h, big_key, big.data(), big.size()) == 0);
+  rt_store_close(h2, 0);
+  rt_store_close(h, 1);
+}
+
+void test_concurrent_hammer() {
+  // The TSan target: N threads over one arena doing put/get/delete on
+  // overlapping key ranges; invariants checked at the end.
+  auto name = unique_name("hammer");
+  void* h = rt_store_create(name.c_str(), 8 << 20);
+  const int kThreads = 4;
+  const uint32_t kIters = 300;
+  std::atomic<int> failures{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; t++) {
+    threads.emplace_back([&, t] {
+      std::vector<uint8_t> payload(2048, static_cast<uint8_t>(t));
+      for (uint32_t i = 0; i < kIters; i++) {
+        uint8_t key[kKeySize];
+        make_key(key, i % 37, t);  // per-thread key space + churn
+        int rc = rt_store_put(h, key, payload.data(), payload.size());
+        if (rc != 0 && rc != -1 && rc != -5) failures.fetch_add(1);
+        uint64_t size = 0;
+        const uint8_t* ptr = rt_store_get(h, key, &size);
+        if (ptr != nullptr) {
+          if (size != payload.size() || ptr[0] != static_cast<uint8_t>(t))
+            failures.fetch_add(1);
+          rt_store_release(h, key);
+        }
+        rt_store_delete(h, key);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  CHECK(failures.load() == 0);
+  uint64_t c, used, n;
+  rt_store_stats(h, &c, &used, &n);
+  CHECK(n == 0 && used == 0);  // everything deleted, nothing leaked
+  rt_store_close(h, 1);
+}
+
+}  // namespace
+
+int main() {
+  test_put_get_delete_roundtrip();
+  test_alloc_free_coalescing();
+  test_pin_deferred_free();
+  test_create_seal_abort();
+  test_repair_after_torn_state();
+  test_concurrent_hammer();
+  std::printf("shm_store_test: all OK\n");
+  return 0;
+}

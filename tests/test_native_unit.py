@@ -1,0 +1,42 @@
+"""C++ unit tests for the native daemons, run under sanitizers.
+
+SURVEY §4.5: the reference's C++ has TSAN/ASAN CI; the arena store's
+concurrency story must not rest on Python end-to-end tests alone. The
+test binary (tests/native/shm_store_test.cc) covers allocator
+coalescing, pin/deferred-free, seal/abort, EOWNERDEAD repair of torn
+state, and a multithreaded put/get/delete hammer — compiled and run
+twice: AddressSanitizer+UBSan, then ThreadSanitizer.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "tests", "native", "shm_store_test.cc")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no native toolchain")
+
+
+def _build_and_run(tmp_path, sanitize: str) -> None:
+    out = str(tmp_path / f"shm_test_{sanitize.replace(',', '_')}")
+    build = subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-g", f"-fsanitize={sanitize}",
+         "-pthread", SRC, "-o", out, "-lrt"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert build.returncode == 0, build.stderr[-3000:]
+    run = subprocess.run([out], capture_output=True, text=True,
+                         timeout=300)
+    assert run.returncode == 0, (run.stdout + run.stderr)[-3000:]
+    assert "all OK" in run.stdout
+
+
+def test_shm_store_asan_ubsan(tmp_path):
+    _build_and_run(tmp_path, "address,undefined")
+
+
+def test_shm_store_tsan(tmp_path):
+    _build_and_run(tmp_path, "thread")

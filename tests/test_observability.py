@@ -101,6 +101,56 @@ def test_dashboard_http(rt_shared):
         stop_dashboard()
 
 
+def test_dashboard_task_drilldown_and_logs(rt_shared):
+    """Per-task detail + worker log tail over HTTP (reference: dashboard
+    task pages + log proxying)."""
+    import ray_tpu as rt
+    from ray_tpu.observability import start_dashboard, stop_dashboard
+
+    @rt.remote
+    def noisy(x):
+        print(f"working on {x}")
+        return x + 1
+
+    ref = noisy.remote(41)
+    assert rt.get(ref, timeout=30) == 42
+    start_dashboard(port=18267)
+    try:
+        with urllib.request.urlopen(
+                "http://127.0.0.1:18267/api/tasks", timeout=10) as r:
+            tasks = json.loads(r.read())
+        target = next(t for t in tasks if t["name"] == "noisy")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:18267/api/task/{target['task_id']}",
+                timeout=10) as r:
+            detail = json.loads(r.read())
+        assert detail["name"] == "noisy"
+        assert detail["state"] == "DONE"
+        assert detail["returns"] and detail["returns"][0]["status"]
+        assert detail["max_retries"] >= 0
+        # Unknown id answers an error payload, not a 500.
+        with urllib.request.urlopen(
+                "http://127.0.0.1:18267/api/task/" + "ab" * 10,
+                timeout=10) as r:
+            assert "error" in json.loads(r.read())
+        with urllib.request.urlopen(
+                "http://127.0.0.1:18267/api/workers", timeout=10) as r:
+            workers = json.loads(r.read())
+        assert workers
+        found_print = False
+        for w in workers:
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:18267/api/logs/"
+                    f"{w['worker_id']}?n=50", timeout=10) as r:
+                logs = json.loads(r.read())
+            if logs.get("out") and any("working on 41" in line
+                                       for line in logs["out"]):
+                found_print = True
+        assert found_print, "task stdout not reachable over HTTP"
+    finally:
+        stop_dashboard()
+
+
 def test_timeline_spans(tmp_path):
     from ray_tpu.observability import record_span, timeline
 
