@@ -72,11 +72,15 @@ def task_detail(task_id_hex: str) -> Dict[str, Any]:
         return {"error": f"invalid task id {task_id_hex!r}"}
     with rt._lock:
         rec = rt._tasks.get(task_id)
-    if rec is None:
-        return {"error": f"unknown task {task_id_hex}"}
-    spec = rec.spec
-    returns = []
-    with rt._lock:
+        if rec is None:
+            return {"error": f"unknown task {task_id_hex}"}
+        # Snapshot mutable record fields under the ONE lock hold: the
+        # retry path nulls node/worker concurrently (check-then-use
+        # outside the lock races an AttributeError into a 500).
+        spec = rec.spec
+        node, worker = rec.node, rec.worker
+        state, retries_left = rec.state, rec.retries_left
+        returns = []
         for oid in spec.return_ids():
             entry = rt._objects.get(oid)
             returns.append({
@@ -87,14 +91,13 @@ def task_detail(task_id_hex: str) -> Dict[str, Any]:
         "task_id": spec.task_id.hex(),
         "name": spec.name or spec.method_name or "",
         "type": spec.task_type.name,
-        "state": rec.state,
+        "state": state,
         "resources": dict(spec.resources),
         "strategy": spec.strategy.kind,
-        "node_id": rec.node.node_id.hex() if rec.node else None,
-        "worker_id": (rec.worker.worker_id.hex()
-                      if rec.worker else None),
+        "node_id": node.node_id.hex() if node else None,
+        "worker_id": worker.worker_id.hex() if worker else None,
         "actor_id": spec.actor_id.hex() if spec.actor_id else None,
-        "retries_left": rec.retries_left,
+        "retries_left": retries_left,
         "max_retries": spec.max_retries,
         "num_args": len(spec.arg_refs),
         "arg_object_ids": [o.hex() for o in spec.arg_refs],

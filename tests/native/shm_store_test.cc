@@ -201,6 +201,34 @@ void test_concurrent_hammer() {
   }
   for (auto& th : threads) th.join();
   CHECK(failures.load() == 0);
+  // Phase 2: ALL threads on the SAME key — concurrent put vs pinned
+  // get vs deferred delete exercises the per-key state machine
+  // (SEALED / PENDING_DELETE / -1 / -5 transitions), not just the
+  // allocator mutex.
+  std::vector<std::thread> contenders;
+  for (int t = 0; t < kThreads; t++) {
+    contenders.emplace_back([&] {
+      std::vector<uint8_t> payload(1024, 0xEE);
+      uint8_t key[kKeySize];
+      make_key(key, 424242);
+      for (uint32_t i = 0; i < kIters; i++) {
+        int rc = rt_store_put(h, key, payload.data(), payload.size());
+        if (rc != 0 && rc != -1 && rc != -5) failures.fetch_add(1);
+        uint64_t size = 0;
+        const uint8_t* ptr = rt_store_get(h, key, &size);
+        if (ptr != nullptr) {
+          // A pinned extent must stay intact even if another thread
+          // deletes the key (deferred free).
+          if (size != payload.size() || ptr[0] != 0xEE)
+            failures.fetch_add(1);
+          rt_store_release(h, key);
+        }
+        rt_store_delete(h, key);
+      }
+    });
+  }
+  for (auto& th : contenders) th.join();
+  CHECK(failures.load() == 0);
   uint64_t c, used, n;
   rt_store_stats(h, &c, &used, &n);
   CHECK(n == 0 && used == 0);  // everything deleted, nothing leaked
