@@ -299,11 +299,16 @@ def bench_serve() -> dict:
     import ray_tpu as rt
     from ray_tpu import serve
 
-    rt.init(ignore_reinit_error=True)
+    # Explicit logical CPUs (see microbenchmark.main): auto-sizing gives
+    # 1 CPU on single-core bench hosts, starving the controller +
+    # replica actors of scheduling headroom. Not more than 4: the pool
+    # PRESTARTS num_cpus worker processes, and a 1-core host thrashes
+    # spawning 16 python interpreters at once.
+    rt.init(ignore_reinit_error=True, num_cpus=4)
     serve.start(http_port=18199)
     out = {}
 
-    def measure(tag, n_replicas, n_clients, duration=3.0):
+    def measure(tag, n_replicas, n_clients, duration=6.0):
         import threading
 
         @serve.deployment(name=f"noop{n_replicas}",
@@ -318,37 +323,55 @@ def bench_serve() -> dict:
         rt.get([handle.remote() for _ in range(4 * n_replicas)],
                timeout=120)
         path = f"/noop{n_replicas}"
-        counts = [0] * n_clients
-        stop = time.perf_counter() + duration
+        # Warm the HTTP path too: the proxy's first requests pay
+        # one-time costs (handle/router bootstrap, controller name
+        # lookup, long-poll listener start) that don't belong in the
+        # steady-state window.
+        warm = http.client.HTTPConnection("127.0.0.1", 18199, timeout=30)
+        for _ in range(100):
+            warm.request("GET", path)
+            warm.getresponse().read()
+        warm.close()
 
-        def client(i):
-            # Persistent connection (keep-alive), like the reference
-            # bench's HTTP client — a new TCP connection per request
-            # (urllib.request) benchmarks the kernel's connect path,
-            # not the proxy.
-            conn = http.client.HTTPConnection("127.0.0.1", 18199,
-                                              timeout=30)
-            try:
-                while time.perf_counter() < stop:
-                    conn.request("GET", path)
-                    resp = conn.getresponse()
-                    resp.read()
-                    # http.client never raises on status (urllib did):
-                    # without this, a broken instance returning fast
-                    # 500s would report inflated req/s.
-                    assert resp.status == 200, f"HTTP {resp.status}"
-                    counts[i] += 1
-            finally:
-                conn.close()
+        def run_window(window_s: float) -> float:
+            counts = [0] * n_clients
+            stop_box = [0.0]
 
-        t0 = time.perf_counter()
-        threads = [threading.Thread(target=client, args=(i,))
-                   for i in range(n_clients)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        out[tag] = round(sum(counts) / (time.perf_counter() - t0), 1)
+            def client(i):
+                # Persistent connection (keep-alive), like the
+                # reference bench's HTTP client — a new TCP connection
+                # per request (urllib.request) benchmarks the kernel's
+                # connect path, not the proxy.
+                conn = http.client.HTTPConnection("127.0.0.1", 18199,
+                                                  timeout=30)
+                try:
+                    while time.perf_counter() < stop_box[0]:
+                        conn.request("GET", path)
+                        resp = conn.getresponse()
+                        resp.read()
+                        # http.client never raises on status (urllib
+                        # did): without this, a broken instance
+                        # returning fast 500s would inflate req/s.
+                        assert resp.status == 200, f"HTTP {resp.status}"
+                        counts[i] += 1
+                finally:
+                    conn.close()
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(n_clients)]
+            t0 = time.perf_counter()
+            stop_box[0] = t0 + window_s
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return sum(counts) / (time.perf_counter() - t0)
+
+        # Median of three windows: single short windows land on the
+        # interpreter/scheduler warmup ramp and under-report steady
+        # state by ~30% on 1-core hosts.
+        rates = sorted(run_window(duration) for _ in range(3))
+        out[tag] = round(rates[1], 1)
         # python-handle path (no HTTP parse) for comparison
         t0 = time.perf_counter()
         m = 0

@@ -513,6 +513,53 @@ class Router:
     def assign(self, method: Optional[str], args, kwargs):
         return self.assign_with_replica(method, args, kwargs)[0]
 
+    def _pick_slot_locked(self):
+        """Under self._slot_free: round-robin pick of a replica with a
+        free in-flight slot; None when all are at capacity."""
+        n = len(self._replicas)
+        for probe in range(n):
+            idx = (self._rr + probe) % n
+            replica = self._replicas[idx]
+            key = replica._actor_id.binary()
+            if self._inflight.get(key, 0) < self._max_cq:
+                self._rr = idx + 1
+                self._inflight[key] = self._inflight.get(key, 0) + 1
+                return replica, key
+        return None
+
+    def _submit(self, replica, key, method, args, kwargs):
+        try:
+            if method:
+                ref = replica.call_method.remote(method, args, kwargs)
+            else:
+                ref = replica.handle_request.remote(args, kwargs)
+        except Exception:
+            self._release(key)
+            raise
+
+        from ..core import on_ref_ready
+
+        on_ref_ready(ref, lambda k=key: self._release(k))
+        return ref, replica
+
+    def try_assign_with_replica(self, method: Optional[str], args,
+                                kwargs):
+        """Non-blocking assign: (ref, replica) or None when every
+        replica is at capacity — lets the HTTP proxy submit inline on
+        its event loop in the common unsaturated case instead of paying
+        a thread-pool hop per request. STRICTLY non-blocking: an empty
+        replica set returns None (the caller's off-loop slow path runs
+        the bootstrap RPC) so a slow controller can never stall the
+        proxy's event loop."""
+        if not self._replicas:
+            return None
+        with self._slot_free:
+            chosen = self._pick_slot_locked()
+        if chosen is None:
+            return None
+        replica, key = chosen
+        return self._submit(replica, key, method, args, kwargs)
+
     def assign_with_replica(self, method: Optional[str], args, kwargs):
         """Pick a replica with a free slot; block (condvar, woken by
         completions and replica-set updates) when all are at capacity.
@@ -521,23 +568,14 @@ class Router:
         deadline = time.monotonic() + 30
         self._ensure_replicas()
         while True:
-            chosen = None
             with self._slot_free:
-                n = len(self._replicas)
-                for probe in range(n):
-                    idx = (self._rr + probe) % n
-                    replica = self._replicas[idx]
-                    key = replica._actor_id.binary()
-                    if self._inflight.get(key, 0) < self._max_cq:
-                        self._rr = idx + 1
-                        self._inflight[key] = self._inflight.get(key, 0) + 1
-                        chosen = (replica, key)
-                        break
+                chosen = self._pick_slot_locked()
                 if chosen is None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         detail = (f" (all at max_concurrent_queries="
-                                  f"{self._max_cq})" if n else "")
+                                  f"{self._max_cq})"
+                                  if self._replicas else "")
                         raise RuntimeError(
                             f"no replica available for "
                             f"{self._name!r}{detail}")
@@ -546,19 +584,7 @@ class Router:
                 self._ensure_replicas()
                 continue
             replica, key = chosen
-            try:
-                if method:
-                    ref = replica.call_method.remote(method, args, kwargs)
-                else:
-                    ref = replica.handle_request.remote(args, kwargs)
-            except Exception:
-                self._release(key)
-                raise
-
-            from ..core import on_ref_ready
-
-            on_ref_ready(ref, lambda k=key: self._release(k))
-            return ref, replica
+            return self._submit(replica, key, method, args, kwargs)
 
     def _release(self, key: bytes) -> None:
         with self._slot_free:

@@ -432,13 +432,18 @@ class _AsyncHTTPProxy:
                     return True
                 handle = DeploymentHandle(name)
                 self._handles[name] = handle
-            # assign() can block on max_concurrent_queries backpressure —
-            # run it off-loop so one saturated deployment doesn't stall
-            # other connections.
+            # Fast path: submit inline on the event loop when a slot is
+            # free (the common case — saves a thread-pool hop per
+            # request); only saturated deployments take the off-loop
+            # blocking assign so they don't stall other connections.
             args = () if payload is None else (payload,)
-            ref, replica = await self._loop.run_in_executor(
-                None, lambda: handle._router.assign_with_replica(
-                    None, args, {}))
+            assigned = handle._router.try_assign_with_replica(
+                None, args, {})
+            if assigned is None:
+                assigned = await self._loop.run_in_executor(
+                    None, lambda: handle._router.assign_with_replica(
+                        None, args, {}))
+            ref, replica = assigned
             result = await self._aget(ref, 60)
         except Exception as e:  # noqa: BLE001
             try:
