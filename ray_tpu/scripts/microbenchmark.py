@@ -114,8 +114,8 @@ def main(duration: float = 2.0) -> List[Dict]:
     # Call-count warmup: a fresh actor's dedicated worker PROCESS runs
     # its first ~1.5-2k calls at a fraction of steady state (interpreter
     # specialization + thread/pipe ramp); a time-based warmup at the
-    # cold rate doesn't cover it.
-    for _ in range(2000):
+    # cold rate doesn't cover it. Scaled down for quick smoke runs.
+    for _ in range(min(2000, max(200, int(2000 * duration)))):
         rt.get(a.method.remote())
     results.append(timeit("1:1 actor calls sync",
                           lambda: rt.get(a.method.remote()),
@@ -127,9 +127,10 @@ def main(duration: float = 2.0) -> List[Dict]:
     results.append(timeit("1:1 actor calls async (batch 100)", actor_async,
                           multiplier=100, duration=duration))
 
-    # n:n — 4 actors, 4 batches in flight
+    # n:n — 4 actors, 4 batches in flight; warmup matches the per-worker
+    # cold threshold above (~2k calls per fresh actor), duration-scaled.
     actors = [Actor.remote() for _ in range(4)]
-    for _ in range(8):
+    for _ in range(min(80, max(8, int(80 * duration)))):
         rt.get([x.method.remote(i) for x in actors for i in range(25)])
 
     def nn_calls():
