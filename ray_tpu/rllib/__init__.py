@@ -35,8 +35,10 @@ from .replay_buffers import (
     ReplayBuffer,
     ReservoirReplayBuffer,
 )
+from .marwil import BC, BCConfig, MARWIL, MARWILConfig
 from .rollout_worker import RolloutWorker
 from .sac import SAC, SACConfig
+from .td3 import TD3, TD3Config
 from .sample_batch import SampleBatch, compute_gae
 
 __all__ = [
@@ -51,8 +53,10 @@ __all__ = [
     "WeightedImportanceSampling",
     "Algorithm", "AlgorithmConfig", "AtariSim", "DQN", "DQNConfig",
     "FastCartPole", "FastPendulum", "GymVectorEnv", "Impala",
+    "BC", "BCConfig", "MARWIL", "MARWILConfig",
     "ImpalaConfig", "JAX_ENVS", "MODEL_DEFAULTS", "Network", "SAC",
-    "SACConfig", "get_network", "register_custom_model",
+    "SACConfig", "TD3", "TD3Config", "get_network",
+    "register_custom_model",
     "JaxEnv", "JaxPolicy", "MultiAgentReplayBuffer", "OnDevicePPO", "PPO",
     "PPOConfig", "PrioritizedReplayBuffer", "ReplayBuffer",
     "ReservoirReplayBuffer", "RolloutWorker", "SampleBatch", "VectorEnv",

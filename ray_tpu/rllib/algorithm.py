@@ -39,6 +39,9 @@ class AlgorithmConfig:
         # Catalog model config (reference: config.model / MODEL_DEFAULTS):
         # fcnet_hiddens, use_lstm, lstm_cell_size, custom_model, ...
         self.model: Optional[Dict[str, Any]] = None
+        # Algorithm-specific keys forwarded into every worker's
+        # _make_policy cfg (e.g. TD3's explore_sigma).
+        self.policy_config_extra: Dict[str, Any] = {}
         self.extra: Dict[str, Any] = {}
 
     def environment(self, env: Any = None, **kwargs) -> "AlgorithmConfig":
@@ -101,11 +104,13 @@ class WorkerSet:
     def __init__(self, config: AlgorithmConfig, worker_cls=None):
         self.config = config
         worker_cls = worker_cls or RolloutWorker
+        policy_cfg = {"hidden": config.policy_hidden,
+                      "network": config.policy_network,
+                      "model": config.model,
+                      **config.policy_config_extra}
         self.local_worker = worker_cls(
             config.env, config.num_envs_per_worker,
-            {"hidden": config.policy_hidden,
-             "network": config.policy_network,
-             "model": config.model}, seed=config.seed,
+            dict(policy_cfg), seed=config.seed,
         )
         self.remote_workers: List[Any] = []
         if config.num_rollout_workers > 0:
@@ -113,9 +118,7 @@ class WorkerSet:
             self.remote_workers = [
                 remote_cls.options(num_cpus=1).remote(
                     config.env, config.num_envs_per_worker,
-                    {"hidden": config.policy_hidden,
-                     "network": config.policy_network,
-                     "model": config.model},
+                    dict(policy_cfg),
                     seed=config.seed, worker_index=i + 1,
                 )
                 for i in range(config.num_rollout_workers)

@@ -1,0 +1,219 @@
+"""MARWIL / BC: offline policy learning from logged JSONL data.
+
+Reference analog: ``rllib/algorithms/marwil/marwil.py`` (MARWIL —
+monotonic advantage re-weighted imitation learning; exponentially
+advantage-weighted log-likelihood with a learned value baseline) and
+``rllib/algorithms/bc/bc.py`` (behavior cloning = MARWIL with beta=0).
+JAX re-design: the whole update (advantage estimate, weighting, policy +
+value loss) is one jit program; data comes from the offline
+``JsonReader`` (the output of ``JsonWriter`` collection runs).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .offline import JsonReader
+from .sample_batch import ACTIONS, DONES, OBS, REWARDS, SampleBatch
+
+
+def _monte_carlo_returns(batch: SampleBatch, gamma: float) -> np.ndarray:
+    """Discounted return-to-go per step; DONES bound episodes.
+
+    Accepts flat episode-sequential [T] columns OR time-major [T, N]
+    columns from vectorized rollout logs (each env column scanned
+    independently — flattening [T, N] first would interleave episodes
+    and corrupt every return). Returns match the column's shape."""
+    rewards = np.asarray(batch[REWARDS], np.float32)
+    dones = np.asarray(batch[DONES], bool)
+    flat = rewards.ndim == 1
+    if flat:
+        rewards = rewards[:, None]
+        dones = dones.reshape(-1)[:, None]
+    out = np.zeros_like(rewards)
+    acc = np.zeros(rewards.shape[1], np.float32)
+    for t in range(rewards.shape[0] - 1, -1, -1):
+        acc = np.where(dones[t], 0.0, acc)
+        acc = rewards[t] + gamma * acc
+        out[t] = acc
+    return out[:, 0] if flat else out
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self._algo_class = MARWIL
+        self.beta = 1.0  # 0.0 => pure behavior cloning
+        self.vf_coeff = 1.0
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.num_updates_per_iter = 32
+        self.input_path: str = ""
+        self.moving_average_sqd_adv_norm_update_rate = 1e-2
+
+    def offline_data(self, input_path: str) -> "MARWILConfig":
+        self.input_path = input_path
+        return self
+
+    def training(self, **kwargs) -> "MARWILConfig":
+        for k in ("beta", "vf_coeff", "num_updates_per_iter",
+                  "moving_average_sqd_adv_norm_update_rate"):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        super().training(**kwargs)
+        return self
+
+
+class BCConfig(MARWILConfig):
+    """Behavior cloning (reference: bc.py BC = MARWIL with beta=0)."""
+
+    def __init__(self):
+        super().__init__()
+        self._algo_class = BC
+        self.beta = 0.0
+
+
+class MARWIL(Algorithm):
+    """training_step: sample offline minibatch -> one jit update
+    (advantage-weighted NLL + value regression). The WorkerSet's env is
+    used only for EVALUATION (evaluate() rolls the learned policy out).
+    """
+
+    def setup(self, config: MARWILConfig) -> None:
+        import optax
+
+        super().setup(config)
+        if not config.input_path:
+            raise ValueError("MARWIL/BC needs config.offline_data(path)")
+        data = JsonReader(config.input_path).read_all()
+        # Returns are computed at the logged shape (flat [T] or
+        # time-major [T, N]) BEFORE flattening — flattening first would
+        # interleave the N envs' episodes.
+        returns = _monte_carlo_returns(data, config.gamma).reshape(-1)
+        obs = np.asarray(data[OBS], np.float32)
+        self._data = {
+            OBS: obs.reshape(len(returns), -1),
+            ACTIONS: np.asarray(data[ACTIONS]).reshape(-1),
+            "returns": returns,
+        }
+        self._rng_np = np.random.default_rng(config.seed)
+        policy = self.workers.local_worker.policy
+        self.params = policy.params
+        apply_fn = policy.net.apply
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        beta, vfc = config.beta, config.vf_coeff
+        ma_rate = config.moving_average_sqd_adv_norm_update_rate
+
+        def loss(params, batch, adv_norm):
+            logits, values = apply_fn(params, batch[OBS])
+            logp_all = jax.nn.log_softmax(logits)
+            actions = batch[ACTIONS].astype(jnp.int32)
+            logp = jnp.take_along_axis(logp_all, actions[:, None],
+                                       axis=-1)[:, 0]
+            adv = batch["returns"] - jax.lax.stop_gradient(values)
+            if beta > 0:
+                # Advantage-weighted imitation with a running norm
+                # (reference: marwil_tf_policy explained_variance /
+                # ma_adv_norm), clipped for stability.
+                weights = jnp.exp(beta * jnp.clip(
+                    adv / jnp.sqrt(adv_norm + 1e-8), -10.0, 10.0))
+                weights = jnp.minimum(weights, 20.0)
+            else:
+                weights = jnp.ones_like(logp)
+            policy_loss = -jnp.mean(
+                jax.lax.stop_gradient(weights) * logp)
+            vf_loss = jnp.mean((values - batch["returns"]) ** 2)
+            total = policy_loss + vfc * vf_loss
+            new_norm = adv_norm + ma_rate * (
+                jnp.mean(adv ** 2) - adv_norm)
+            return total, {"policy_loss": policy_loss,
+                           "vf_loss": vf_loss,
+                           "adv_norm": new_norm}
+
+        optimizer = self.optimizer
+
+        @jax.jit
+        def update(params, opt_state, batch, adv_norm):
+            (total, aux), grads = jax.value_and_grad(
+                loss, has_aux=True)(params, batch, adv_norm)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, total, aux
+
+        self._update = update
+        self._adv_norm = jnp.asarray(1.0)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        n = len(self._data["returns"])
+        total = aux = None
+        for _ in range(cfg.num_updates_per_iter):
+            idx = self._rng_np.integers(0, n, cfg.train_batch_size)
+            batch = {k: jnp.asarray(v[idx])
+                     for k, v in self._data.items()}
+            self.params, self.opt_state, total, aux = self._update(
+                self.params, self.opt_state, batch, self._adv_norm)
+            self._adv_norm = aux["adv_norm"]
+        self._timesteps_total += (cfg.num_updates_per_iter
+                                  * cfg.train_batch_size)
+        weights = jax.tree.map(np.asarray, self.params)
+        self.workers.local_worker.set_weights(weights)
+        self.workers.sync_weights(weights)
+        return {
+            "timesteps_this_iter": (cfg.num_updates_per_iter
+                                    * cfg.train_batch_size),
+            "total_loss": float(total),
+            "policy_loss": float(aux["policy_loss"]),
+            "vf_loss": float(aux["vf_loss"]),
+        }
+
+    def evaluate(self, episodes: int = 5) -> Dict:
+        """Roll the learned policy out in the WorkerSet's env."""
+        worker = self.workers.local_worker
+        env = worker.env
+        rewards = []
+        obs = env.vector_reset(seed=self.config.seed + 99)
+        ep_rew = np.zeros(env.num_envs, np.float32)
+        while len(rewards) < episodes:
+            actions, _, _ = worker.policy.compute_actions(
+                obs, deterministic=True)
+            obs, r, dones, _ = env.vector_step(actions)
+            ep_rew += r
+            for i in np.nonzero(dones)[0]:
+                rewards.append(float(ep_rew[i]))
+                ep_rew[i] = 0.0
+        return {"episode_reward_mean": float(np.mean(rewards)),
+                "episodes": len(rewards)}
+
+    def get_state(self) -> Dict:
+        state = super().get_state()
+        state["params"] = jax.tree.map(np.asarray, self.params)
+        state["adv_norm"] = float(self._adv_norm)
+        state["opt_state"] = jax.tree.map(np.asarray, self.opt_state)
+        return state
+
+    def set_state(self, state: Dict) -> None:
+        super().set_state(state)
+        if "params" in state:
+            self.params = jax.tree.map(jnp.asarray, state["params"])
+            weights = jax.tree.map(np.asarray, self.params)
+            self.workers.local_worker.set_weights(weights)
+            self.workers.sync_weights(weights)
+        if "adv_norm" in state:
+            # A reset normalizer would inflate the exp advantage
+            # weights after every resume (loss spike / policy lurch).
+            self._adv_norm = jnp.asarray(state["adv_norm"])
+        if "opt_state" in state:
+            self.opt_state = jax.tree.map(jnp.asarray,
+                                          state["opt_state"])
+
+
+class BC(MARWIL):
+    """Behavior cloning (reference: ``rllib/algorithms/bc``)."""

@@ -1,0 +1,298 @@
+"""TD3: twin-delayed deterministic policy gradients (continuous control).
+
+Reference analog: ``rllib/algorithms/ddpg/`` family with the TD3 flags
+(``twin_q``, ``policy_delay``, ``smooth_target_policy`` — td3.py
+presets): deterministic tanh actor, twin Q critics, clipped Gaussian
+TARGET-policy smoothing, delayed actor updates, polyak targets. Shares
+the MLP/critic machinery with SAC (``sac.py``); one jit program per
+update step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .replay_buffers import ReplayBuffer
+from .sac import SACRolloutWorker, _init_mlp, _mlp, _q
+from .sample_batch import ACTIONS, DONES, NEXT_OBS, OBS, REWARDS
+
+
+def init_td3_params(key, obs_dim: int, action_dim: int,
+                    hidden=(256, 256)) -> Dict:
+    ka, k1, k2 = jax.random.split(key, 3)
+    sizes = [obs_dim] + list(hidden)
+    qsizes = [obs_dim + action_dim] + list(hidden)
+    actor = _init_mlp(ka, sizes, action_dim, out_std=0.01)
+    q1 = _init_mlp(k1, qsizes, 1, out_std=0.1)
+    q2 = _init_mlp(k2, qsizes, 1, out_std=0.1)
+    return {
+        "actor": actor, "q1": q1, "q2": q2,
+        "target_actor": jax.tree.map(jnp.copy, actor),
+        "target_q1": jax.tree.map(jnp.copy, q1),
+        "target_q2": jax.tree.map(jnp.copy, q2),
+    }
+
+
+def deterministic_action(actor: Dict, obs, low: float, high: float):
+    scale = (high - low) / 2.0
+    return low + (jnp.tanh(_mlp(actor, obs.astype(jnp.float32)))
+                  + 1.0) * scale
+
+
+class TD3Policy:
+    """Deterministic actor + Gaussian EXPLORATION noise for rollouts
+    (reference: ddpg GaussianNoise exploration)."""
+
+    def __init__(self, obs_shape: Tuple[int, ...], action_dim: int,
+                 low: float, high: float, hidden=(256, 256),
+                 seed: int = 0, explore_sigma: float = 0.1):
+        self.obs_dim = int(np.prod(obs_shape))
+        self.action_dim = action_dim
+        self.low, self.high = float(low), float(high)
+        self.explore_sigma = explore_sigma
+        # Uniform-random warmup (reference: ddpg random_timesteps /
+        # TD3's start_steps): an untrained tanh actor emits ~zero
+        # actions and never explores; the learner flips this off once
+        # the buffer holds learning_starts transitions.
+        self.random_phase = True
+        self.params = init_td3_params(
+            jax.random.PRNGKey(seed), self.obs_dim, action_dim, hidden)
+        self._rng = np.random.default_rng(seed + 1)
+
+        @jax.jit
+        def _act(actor, obs):
+            return deterministic_action(actor, obs, self.low, self.high)
+
+        self._act = _act
+
+    def compute_actions(self, obs: np.ndarray, deterministic: bool = False):
+        obs = np.asarray(obs, np.float32).reshape(len(obs), -1)
+        if self.random_phase and not deterministic:
+            actions = self._rng.uniform(
+                self.low, self.high, (len(obs), self.action_dim))
+            zeros = np.zeros(len(obs), np.float32)
+            return actions.astype(np.float32), zeros, zeros
+        actions = np.asarray(self._act(self.params["actor"],
+                                       jnp.asarray(obs)))
+        if not deterministic:
+            scale = (self.high - self.low) / 2.0
+            noise = self._rng.normal(
+                0.0, self.explore_sigma * scale, actions.shape)
+            actions = np.clip(actions + noise, self.low, self.high)
+        zeros = np.zeros(len(obs), np.float32)
+        return actions.astype(np.float32), zeros, zeros
+
+    def get_weights(self) -> Dict:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Dict) -> None:
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
+class TD3RolloutWorker(SACRolloutWorker):
+    def _make_policy(self, cfg: Dict, seed: int):
+        return TD3Policy(
+            self.env.observation_space_shape, self.env.action_dim,
+            self.env.action_low, self.env.action_high,
+            hidden=cfg.get("hidden", (256, 256)), seed=seed,
+            explore_sigma=cfg.get("explore_sigma", 0.1),
+        )
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self._algo_class = TD3
+        self.env = "FastPendulum"
+        self.lr = 1e-3
+        self.rollout_fragment_length = 8
+        self.train_batch_size = 128
+        self.buffer_capacity = 100_000
+        self.learning_starts = 500
+        self.tau = 0.005
+        self.num_updates_per_iter = 32
+        self.policy_delay = 2  # delayed actor updates (the "TD" in TD3)
+        self.target_noise = 0.2  # target-policy smoothing sigma
+        self.target_noise_clip = 0.5
+        self.explore_sigma = 0.1
+        self.policy_config_extra["explore_sigma"] = self.explore_sigma
+        self.policy_hidden = (256, 256)
+
+    def training(self, **kwargs) -> "TD3Config":
+        for k in ("buffer_capacity", "learning_starts", "tau",
+                  "num_updates_per_iter", "policy_delay", "target_noise",
+                  "target_noise_clip", "explore_sigma"):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        # Rollout policies need the exploration sigma at construction
+        # (WorkerSet forwards policy_config_extra into _make_policy).
+        self.policy_config_extra["explore_sigma"] = self.explore_sigma
+        super().training(**kwargs)
+        return self
+
+
+class TD3(Algorithm):
+    """training_step: sample -> replay add -> K jit updates (critic every
+    step; actor + targets every policy_delay steps) -> sync."""
+
+    _worker_cls = TD3RolloutWorker
+
+    def setup(self, config: TD3Config) -> None:
+        import optax
+
+        super().setup(config)
+        env = self.workers.local_worker.env
+        adim = env.action_dim
+        low, high = float(env.action_low), float(env.action_high)
+        scale = (high - low) / 2.0
+        self.buffer = ReplayBuffer(config.buffer_capacity,
+                                   seed=config.seed)
+        self.params = self.workers.local_worker.policy.params
+        # SEPARATE optimizers: the actor's must only advance on actor
+        # steps — a shared optimizer fed zero actor-grads on critic-only
+        # steps still moves the actor via Adam momentum, silently
+        # defeating the delayed-update schedule.
+        self.critic_opt = optax.adam(config.lr)
+        self.actor_opt = optax.adam(config.lr)
+        self.opt_state = {
+            "critic": self.critic_opt.init(
+                {"q1": self.params["q1"], "q2": self.params["q2"]}),
+            "actor": self.actor_opt.init(self.params["actor"]),
+        }
+        self._num_updates = 0
+        self._warmup_done = False
+        gamma, tau = config.gamma, config.tau
+        tn = config.target_noise * scale
+        tn_clip = config.target_noise_clip * scale
+        def critic_loss(train, params, batch, key):
+            # Target-policy smoothing: noisy clipped target action.
+            target_a = deterministic_action(
+                params["target_actor"], batch[NEXT_OBS], low, high)
+            noise = jnp.clip(
+                tn * jax.random.normal(key, target_a.shape),
+                -tn_clip, tn_clip)
+            target_a = jnp.clip(target_a + noise, low, high)
+            tq = jnp.minimum(
+                _q(params["target_q1"], batch[NEXT_OBS], target_a),
+                _q(params["target_q2"], batch[NEXT_OBS], target_a))
+            not_done = 1.0 - batch[DONES].astype(jnp.float32)
+            target = jax.lax.stop_gradient(
+                batch[REWARDS] + gamma * not_done * tq)
+            q1 = _q(train["q1"], batch[OBS], batch[ACTIONS])
+            q2 = _q(train["q2"], batch[OBS], batch[ACTIONS])
+            return (jnp.mean((q1 - target) ** 2)
+                    + jnp.mean((q2 - target) ** 2))
+
+        def actor_loss(actor, critics, batch):
+            a = deterministic_action(actor, batch[OBS], low, high)
+            return -jnp.mean(_q(jax.lax.stop_gradient(critics["q1"]),
+                                batch[OBS], a))
+
+        critic_opt, actor_opt = self.critic_opt, self.actor_opt
+
+        @jax.jit
+        def update(params, opt_state, batch, key, do_actor):
+            critics = {"q1": params["q1"], "q2": params["q2"]}
+            c_loss, c_grads = jax.value_and_grad(critic_loss)(
+                critics, params, batch, key)
+            c_updates, critic_state = critic_opt.update(
+                c_grads, opt_state["critic"], critics)
+            critics = optax.apply_updates(critics, c_updates)
+
+            def with_actor(_):
+                a_loss, a_grads = jax.value_and_grad(actor_loss)(
+                    params["actor"], critics, batch)
+                a_updates, actor_state = actor_opt.update(
+                    a_grads, opt_state["actor"], params["actor"])
+                actor = optax.apply_updates(params["actor"], a_updates)
+
+                def polyak(t, o):
+                    return jax.tree.map(
+                        lambda a, b: (1 - tau) * a + tau * b, t, o)
+
+                return (actor, actor_state, a_loss,
+                        polyak(params["target_q1"], critics["q1"]),
+                        polyak(params["target_q2"], critics["q2"]),
+                        polyak(params["target_actor"], actor))
+
+            def without_actor(_):
+                # Critic-only step: actor, its optimizer state, and ALL
+                # targets stay frozen (the "delayed" in TD3).
+                return (params["actor"], opt_state["actor"],
+                        jnp.asarray(0.0), params["target_q1"],
+                        params["target_q2"], params["target_actor"])
+
+            (actor, actor_state, a_loss, tq1, tq2, ta) = jax.lax.cond(
+                do_actor, with_actor, without_actor, None)
+            new = dict(params)
+            new.update({"actor": actor, "q1": critics["q1"],
+                        "q2": critics["q2"], "target_q1": tq1,
+                        "target_q2": tq2, "target_actor": ta})
+            return (new, {"critic": critic_state, "actor": actor_state},
+                    {"critic_loss": c_loss, "actor_loss": a_loss})
+
+        self._update = update
+        self._key = jax.random.PRNGKey(config.seed + 23)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        batches = self.workers.sample(cfg.rollout_fragment_length)
+        new_steps = 0
+        for b in batches:
+            self.buffer.add(b)
+            new_steps += b.count
+        self._timesteps_total += new_steps
+        aux_out = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            if not self._warmup_done:
+                self._warmup_done = True
+                self.workers.foreach_worker(
+                    lambda w: setattr(w.policy, "random_phase", False))
+            actor_loss = None
+            for _ in range(cfg.num_updates_per_iter):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                jbatch = {k: jnp.asarray(v) for k, v in batch.items()
+                          if k != "batch_indexes"}
+                self._key, sub = jax.random.split(self._key)
+                is_actor_step = (
+                    self._num_updates % cfg.policy_delay == 0)
+                self.params, self.opt_state, aux = self._update(
+                    self.params, self.opt_state, jbatch, sub,
+                    jnp.asarray(is_actor_step))
+                if is_actor_step:
+                    actor_loss = aux["actor_loss"]
+                self._num_updates += 1
+            aux_out = {"critic_loss": float(aux["critic_loss"])}
+            if actor_loss is not None:
+                aux_out["actor_loss"] = float(actor_loss)
+            weights = jax.tree.map(np.asarray, self.params)
+            self.workers.local_worker.set_weights(weights)
+            self.workers.sync_weights(weights)
+        return {
+            "timesteps_this_iter": new_steps,
+            "num_learner_updates": self._num_updates,
+            "replay_buffer_size": len(self.buffer),
+            **aux_out,
+        }
+
+    def get_state(self) -> Dict:
+        state = super().get_state()
+        state.update({
+            "params": jax.tree.map(np.asarray, self.params),
+            "num_updates": self._num_updates,
+        })
+        return state
+
+    def set_state(self, state: Dict) -> None:
+        super().set_state(state)
+        if "params" in state:
+            self.params = jax.tree.map(jnp.asarray, state["params"])
+            self._num_updates = state.get("num_updates", 0)
+            weights = jax.tree.map(np.asarray, self.params)
+            self.workers.local_worker.set_weights(weights)
+            self.workers.sync_weights(weights)
