@@ -16,6 +16,7 @@ from .search import (
     Choice,
     Domain,
     GridSearch,
+    ConcurrencyLimiter,
     RandomSearch,
     TPESearcher,
     Searcher,
@@ -39,7 +40,7 @@ from .tuner import (
 __all__ = [
     "AsyncHyperBandScheduler", "BasicVariantGenerator", "Choice", "Domain",
     "FIFOScheduler", "GridSearch", "MedianStoppingRule",
-    "PopulationBasedTraining", "RandomSearch", "ResultGrid", "Searcher", "TPESearcher",
+    "ConcurrencyLimiter", "PopulationBasedTraining", "RandomSearch", "ResultGrid", "Searcher", "TPESearcher",
     "Trial", "TrialDecision", "TrialRunner", "TrialScheduler", "TrialStatus",
     "TuneConfig", "Tuner", "choice", "grid_search", "loguniform", "randint",
     "report", "run", "uniform",

@@ -300,3 +300,31 @@ class TPESearcher(Searcher):
 
 class RandomSearch(BasicVariantGenerator):
     """Pure random sampling (no grid keys required)."""
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions from a wrapped searcher (reference:
+    ``tune/search/concurrency_limiter.py``): model-based searchers like
+    TPE degrade when many trials launch before any feedback arrives —
+    the limiter returns None (no new trial) while ``max_concurrent``
+    suggestions are outstanding."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int = 2):
+        if max_concurrent < 1:
+            raise ValueError("max_concurrent must be >= 1")
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id: str):
+        if len(self._live) >= self.max_concurrent:
+            return None
+        config = self.searcher.suggest(trial_id)
+        if config is not None:
+            self._live.add(trial_id)
+        return config
+
+    def on_trial_complete(self, trial_id: str, result=None,
+                          error: bool = False) -> None:
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error=error)

@@ -139,3 +139,54 @@ def test_pbt_exploits(rt_shared):
     ).fit()
     best = results.get_best_result("score", mode="max")
     assert best.last_result["score"] > 10  # exploited trials climbed
+
+
+def test_concurrency_limiter(rt_init):
+    """Wrapped searchers never exceed max_concurrent in-flight trials
+    (reference: tune/search/concurrency_limiter.py)."""
+    import ray_tpu as rt
+    from ray_tpu import tune
+    from ray_tpu.tune import ConcurrencyLimiter, Tuner, TuneConfig
+    from ray_tpu.tune.search import RandomSearch
+
+    @rt.remote
+    class Gauge:
+        def __init__(self):
+            self.cur = 0
+            self.peak = 0
+
+        def enter(self):
+            self.cur += 1
+            self.peak = max(self.peak, self.cur)
+
+        def leave(self):
+            self.cur -= 1
+
+        def peak_value(self):
+            return self.peak
+
+    gauge = Gauge.remote()
+
+    def trainable(config):
+        import time
+
+        import ray_tpu as rt2
+
+        rt2.get(gauge.enter.remote())
+        time.sleep(0.3)
+        tune.report({"score": config["x"]})
+        rt2.get(gauge.leave.remote())
+
+    limiter = ConcurrencyLimiter(
+        RandomSearch({"x": tune.uniform(0, 1)}, num_samples=6),
+        max_concurrent=2)
+    result = Tuner(
+        trainable,
+        tune_config=TuneConfig(max_concurrent_trials=4,
+                               search_alg=limiter),
+    ).fit()
+    assert len(result.trials) == 6
+    import ray_tpu as rt3
+
+    peak = rt3.get(gauge.peak_value.remote())
+    assert peak <= 2, f"limiter exceeded cap: {peak}"
