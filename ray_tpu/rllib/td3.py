@@ -91,7 +91,11 @@ class TD3Policy:
         return jax.tree.map(np.asarray, self.params)
 
     def set_weights(self, weights: Dict) -> None:
-        self.params = jax.tree.map(jnp.asarray, weights)
+        # MERGE: the learner syncs only the subtree workers need (the
+        # actor — critics/targets are learner-side), but a full tree
+        # from checkpoint restore also lands correctly.
+        self.params = {**self.params,
+                       **jax.tree.map(jnp.asarray, weights)}
 
 
 class TD3RolloutWorker(SACRolloutWorker):
@@ -145,6 +149,10 @@ class TD3(Algorithm):
     def setup(self, config: TD3Config) -> None:
         import optax
 
+        # Authoritative at build time: the attribute may have been set
+        # directly (config.explore_sigma = ...) after __init__/.training
+        # snapshotted it into policy_config_extra.
+        config.policy_config_extra["explore_sigma"] = config.explore_sigma
         super().setup(config)
         env = self.workers.local_worker.env
         adim = env.action_dim
@@ -270,7 +278,10 @@ class TD3(Algorithm):
             aux_out = {"critic_loss": float(aux["critic_loss"])}
             if actor_loss is not None:
                 aux_out["actor_loss"] = float(actor_loss)
-            weights = jax.tree.map(np.asarray, self.params)
+            # Workers only evaluate the actor; shipping critics+targets
+            # too would 6x the per-iteration broadcast for nothing.
+            weights = {"actor": jax.tree.map(np.asarray,
+                                             self.params["actor"])}
             self.workers.local_worker.set_weights(weights)
             self.workers.sync_weights(weights)
         return {
@@ -285,6 +296,9 @@ class TD3(Algorithm):
         state.update({
             "params": jax.tree.map(np.asarray, self.params),
             "num_updates": self._num_updates,
+            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+            "warmup_done": self._warmup_done,
+            "rng_key": np.asarray(self._key),
         })
         return state
 
@@ -296,3 +310,15 @@ class TD3(Algorithm):
             weights = jax.tree.map(np.asarray, self.params)
             self.workers.local_worker.set_weights(weights)
             self.workers.sync_weights(weights)
+        if "opt_state" in state:
+            # A zeroed Adam state after resume causes a loss spike.
+            self.opt_state = jax.tree.map(jnp.asarray,
+                                          state["opt_state"])
+        if "rng_key" in state:
+            self._key = jnp.asarray(state["rng_key"])
+        if state.get("warmup_done"):
+            # Do NOT re-enter uniform-random warmup with a trained
+            # policy — reward would collapse after every resume.
+            self._warmup_done = True
+            self.workers.foreach_worker(
+                lambda w: setattr(w.policy, "random_phase", False))
