@@ -312,25 +312,71 @@ static void repair_store(Store* s) {
     }
     exts[j] = e;
   }
-  // Drop overlapping extents (a torn allocation): keep the earlier one.
-  uint64_t used = 0;
-  uint64_t kept = 0;
-  uint64_t prev_end = 0;
-  for (uint64_t i = 0; i < n; i++) {
-    if (exts[i].off < prev_end) {
-      exts[i].slot->state = SLOT_TOMBSTONE;
-      continue;
+  // Drop overlapping extents (a torn allocation). A SEALED slot is
+  // authoritative — a torn CREATED/PENDING_DELETE extent claiming the
+  // same bytes loses regardless of offset order; among equal states the
+  // earlier (lower-offset) extent wins. The kept list stays strictly
+  // disjoint (sorted, increasing ends), so checking the current extent
+  // against the stack top is sufficient. A losing slot still pinned by
+  // a surviving reader moves to a separate RESERVED list: its bytes
+  // stay out of the free list forever (a reader still maps them and the
+  // winner may own an overlapping subrange, so they can never be freed
+  // safely — a bounded leak until the arena is recreated). Its
+  // alloc_size is zeroed so the reader's final release tombstones the
+  // slot without arena_free'ing bytes it no longer owns.
+  Extent* resv = new Extent[kTableSize];
+  uint64_t n_resv = 0;
+  auto rank_of = [](uint32_t st) {
+    return st == SLOT_SEALED ? 2 : st == SLOT_CREATED ? 1 : 0;
+  };
+  auto lose = [&](const Extent& e) {
+    if (e.slot->refcount > 0) {
+      e.slot->state = SLOT_PENDING_DELETE;
+      e.slot->alloc_size = 0;  // release must never free these bytes
+      e.slot->size = 0;
+      resv[n_resv++] = e;  // extent (by value) stays space-reserved
+    } else {
+      e.slot->state = SLOT_TOMBSTONE;
     }
-    prev_end = exts[i].off + exts[i].size;
-    used += exts[i].size;
-    exts[kept++] = exts[i];
+  };
+  uint64_t kept = 0;
+  for (uint64_t i = 0; i < n; i++) {
+    bool drop_cur = false;
+    while (kept > 0) {
+      Extent& top = exts[kept - 1];
+      if (exts[i].off >= top.off + top.size) break;  // disjoint
+      if (rank_of(exts[i].slot->state) > rank_of(top.slot->state)) {
+        lose(top);
+        kept--;  // recheck the new top for overlap
+      } else {
+        lose(exts[i]);
+        drop_cur = true;
+        break;
+      }
+    }
+    if (!drop_cur) exts[kept++] = exts[i];
   }
-  // Rebuild the free list from the gaps between kept extents.
+  // Fold reserved extents back in for the free-list complement and
+  // re-sort; reserved ranges may overlap winners, so walk the union
+  // with a monotonic cursor.
+  for (uint64_t i = 0; i < n_resv; i++) exts[kept + i] = resv[i];
+  uint64_t m = kept + n_resv;
+  delete[] resv;
+  for (uint64_t i = 1; i < m; i++) {
+    Extent e = exts[i];
+    uint64_t j = i;
+    while (j > 0 && exts[j - 1].off > e.off) {
+      exts[j] = exts[j - 1];
+      j--;
+    }
+    exts[j] = e;
+  }
+  uint64_t used = 0;
   uint64_t free_head = 0;
   uint64_t* link = &free_head;  // where to write the next block's off+1
   uint64_t cursor = 0;
-  for (uint64_t i = 0; i <= kept; i++) {
-    uint64_t gap_end = (i < kept) ? exts[i].off : h->capacity;
+  for (uint64_t i = 0; i <= m; i++) {
+    uint64_t gap_end = (i < m) ? exts[i].off : h->capacity;
     if (gap_end > cursor && gap_end - cursor >= sizeof(FreeBlock)) {
       FreeBlock* blk = reinterpret_cast<FreeBlock*>(arena(s) + cursor);
       blk->size = gap_end - cursor;
@@ -338,11 +384,18 @@ static void repair_store(Store* s) {
       *link = cursor + 1;
       link = &blk->next;
     }
-    if (i < kept) cursor = exts[i].off + exts[i].size;
+    if (i < m) {
+      uint64_t end = exts[i].off + exts[i].size;
+      if (end > cursor) {
+        uint64_t start = exts[i].off > cursor ? exts[i].off : cursor;
+        used += end - start;
+        cursor = end;
+      }
+    }
   }
   h->free_head = free_head;
   h->used_bytes = used;
-  for (uint64_t i = 0; i < kept; i++) {
+  for (uint64_t i = 0; i < m; i++) {
     if (exts[i].slot->state == SLOT_SEALED) sealed++;
   }
   h->num_objects = sealed;
@@ -507,7 +560,11 @@ int rt_store_release(void* handle, const uint8_t* key) {
   if (slot && slot->refcount > 0) {
     slot->refcount--;
     if (slot->refcount == 0 && slot->state == SLOT_PENDING_DELETE) {
-      arena_free(s, slot->offset, slot->alloc_size);
+      // alloc_size == 0 marks a repair-reserved slot whose bytes were
+      // in overlap conflict; they stay reserved (never refreed).
+      if (slot->alloc_size > 0) {
+        arena_free(s, slot->offset, slot->alloc_size);
+      }
       slot->state = SLOT_TOMBSTONE;
     }
   }

@@ -110,9 +110,15 @@ def worker_log_tail(worker_id_prefix: str, n: int = 200
     """Tail a worker's captured stdout/stderr over HTTP (reference:
     dashboard log proxying via the log directory)."""
     import os
+    import re
 
     from ..core.log_monitor import worker_log_path
 
+    # The prefix comes straight from the URL; reject anything that is
+    # not a short hex worker id so it can never traverse out of the
+    # log directory (e.g. ``..%2F..%2Fetc%2Fpasswd``).
+    if not re.fullmatch(r"[0-9a-f]{1,32}", worker_id_prefix):
+        return {"error": "invalid worker id prefix"}
     rt = _head()
     log_dir = getattr(rt, "session_log_dir", None)
     if not log_dir or not os.path.isdir(log_dir):

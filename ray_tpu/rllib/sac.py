@@ -349,6 +349,8 @@ class SAC(Algorithm):
         state.update({
             "params": jax.tree.map(np.asarray, self.params),
             "num_updates": self._num_updates,
+            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+            "rng_key": np.asarray(self._key),
         })
         return state
 
@@ -360,3 +362,8 @@ class SAC(Algorithm):
             weights = jax.tree.map(np.asarray, self.params)
             self.workers.local_worker.set_weights(weights)
             self.workers.sync_weights(weights)
+        if "opt_state" in state:
+            # A zeroed Adam state after resume causes a loss spike.
+            self.opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+        if "rng_key" in state:
+            self._key = jnp.asarray(state["rng_key"])

@@ -23,7 +23,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .checkpoint import Checkpoint, CheckpointManager
 from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
-from .worker_group import WorkerGroup
+from .worker_group import InsufficientResourcesError, WorkerGroup
 
 
 @dataclass
@@ -172,9 +172,9 @@ class DataParallelTrainer:
                 # while to register — waiting for backfill must not burn
                 # max_failures, only exceeding gang_start_timeout_s does.
                 # Only the capacity error (WorkerGroup's reserve
-                # RuntimeError) is retried; config bugs propagate.
+                # failure) is retried; config bugs propagate.
                 executor.start()
-            except RuntimeError as e:
+            except InsufficientResourcesError as e:
                 executor.shutdown()
                 now = time.monotonic()
                 if start_deadline is None:

@@ -75,6 +75,14 @@ class _TrainWorker:
         }
 
 
+class InsufficientResourcesError(RuntimeError):
+    """Gang capacity is not (yet) available — retryable by the Trainer.
+
+    Distinct from plain RuntimeError so a genuine config/setup bug does
+    not silently spin for gang_start_timeout_s before surfacing.
+    """
+
+
 class WorkerGroup:
     """N train-worker actors in a placement group."""
 
@@ -88,7 +96,7 @@ class WorkerGroup:
         self._pg = placement_group(bundles, strategy=placement_strategy)
         if not self._pg.wait(60):
             remove_placement_group(self._pg)
-            raise RuntimeError(
+            raise InsufficientResourcesError(
                 f"could not reserve {num_workers}x{resources} for WorkerGroup"
             )
         worker_cls = remote(_TrainWorker)

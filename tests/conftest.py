@@ -58,7 +58,12 @@ def rt_shared():
     """
     import ray_tpu as rt
 
-    rt.init(num_cpus=4, ignore_reinit_error=True)
+    # An earlier module may have left an auto-inited runtime alive with
+    # machine-sized num_cpus (=1 on this box) — too small for the gang
+    # tests. Always start from a known 4-CPU runtime.
+    if rt.is_initialized():
+        rt.shutdown()
+    rt.init(num_cpus=4)
     # Warm two workers so latency-sensitive tests see a hot pool.
     @rt.remote
     def _noop():

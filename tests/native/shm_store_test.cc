@@ -171,6 +171,109 @@ void test_repair_after_torn_state() {
   rt_store_close(h, 1);
 }
 
+void test_repair_prefers_sealed_pinned() {
+  // A torn CREATED slot forged at a LOWER offset overlapping a pinned
+  // SEALED object must LOSE repair: overlap resolution ranks SEALED
+  // above CREATED regardless of offset order, so the live object stays
+  // readable and its bytes never return to the free list while a
+  // reader holds a zero-copy view.
+  auto name = unique_name("repair_rank");
+  const uint64_t cap = 1 << 20;
+  void* h = rt_store_create(name.c_str(), cap);
+  Store* s = static_cast<Store*>(h);
+  uint8_t sealed_key[kKeySize];
+  make_key(sealed_key, 31);
+  std::vector<uint8_t> payload(4096, 0x5a);
+  CHECK(rt_store_put(h, sealed_key, payload.data(), payload.size()) == 0);
+  uint64_t size = 0;
+  const uint8_t* pinned = rt_store_get(h, sealed_key, &size);  // pin
+  CHECK(pinned != nullptr && size == payload.size());
+  pthread_mutex_lock(&header(s)->mutex);
+  Slot* victim = find_slot(s, sealed_key, false);
+  CHECK(victim != nullptr && victim->state == SLOT_SEALED);
+  uint8_t torn_key[kKeySize];
+  make_key(torn_key, 32);
+  Slot* torn = find_slot(s, torn_key, true);
+  std::memcpy(torn->key, torn_key, kKeySize);
+  torn->state = SLOT_CREATED;
+  torn->offset = victim->offset >= kAlign ? victim->offset - kAlign : 0;
+  torn->alloc_size = victim->alloc_size + 2 * kAlign;  // spans victim
+  torn->size = torn->alloc_size;
+  torn->refcount = 0;
+  repair_store(s);
+  pthread_mutex_unlock(&header(s)->mutex);
+  CHECK(victim->state == SLOT_SEALED);
+  CHECK(torn->state == SLOT_TOMBSTONE);
+  CHECK(std::memcmp(pinned, payload.data(), payload.size()) == 0);
+  // Churn the arena hard: if repair had leaked the pinned extent to the
+  // free list, one of these writes would land on top of it.
+  std::vector<uint8_t> filler(32 * 1024, 0xee);
+  for (uint32_t i = 0; i < 256; i++) {
+    uint8_t k[kKeySize];
+    make_key(k, i, 99);
+    int rc = rt_store_put(h, k, filler.data(), filler.size());
+    CHECK(rc == 0 || rc == -2);  // ok or arena full
+    if (rc == 0 && (i & 1)) rt_store_delete(h, k);
+  }
+  CHECK(std::memcmp(pinned, payload.data(), payload.size()) == 0);
+  rt_store_release(h, sealed_key);
+  rt_store_close(h, 1);
+}
+
+void test_repair_pinned_loser_stays_reserved() {
+  // When a PINNED slot loses overlap resolution (forged SEALED extent
+  // overlapping a real SEALED winner at a lower offset), its bytes must
+  // stay reserved: the surviving reader's release tombstones the slot
+  // WITHOUT returning the conflicted bytes to the allocator.
+  auto name = unique_name("repair_pin");
+  const uint64_t cap = 1 << 20;
+  void* h = rt_store_create(name.c_str(), cap);
+  Store* s = static_cast<Store*>(h);
+  uint8_t winner_key[kKeySize];
+  make_key(winner_key, 41);
+  std::vector<uint8_t> payload(4096, 0x21);
+  CHECK(rt_store_put(h, winner_key, payload.data(), payload.size()) == 0);
+  pthread_mutex_lock(&header(s)->mutex);
+  Slot* winner = find_slot(s, winner_key, false);
+  CHECK(winner != nullptr);
+  // Forge a pinned SEALED slot whose extent sits INSIDE the winner's.
+  uint8_t loser_key[kKeySize];
+  make_key(loser_key, 42);
+  Slot* loser = find_slot(s, loser_key, true);
+  std::memcpy(loser->key, loser_key, kKeySize);
+  loser->state = SLOT_SEALED;
+  loser->offset = winner->offset + kAlign;
+  loser->alloc_size = kAlign;
+  loser->size = kAlign;
+  loser->refcount = 1;  // a surviving reader maps it
+  repair_store(s);
+  pthread_mutex_unlock(&header(s)->mutex);
+  CHECK(winner->state == SLOT_SEALED);
+  CHECK(loser->state == SLOT_PENDING_DELETE);
+  CHECK(loser->alloc_size == 0);  // release must not arena_free
+  uint64_t c0, used0, n0;
+  rt_store_stats(h, &c0, &used0, &n0);
+  CHECK(rt_store_release(h, loser_key) == 0);  // reader lets go
+  uint64_t c1, used1, n1;
+  rt_store_stats(h, &c1, &used1, &n1);
+  CHECK(used1 == used0);  // conflicted bytes were NOT refreed
+  CHECK(loser->state == SLOT_TOMBSTONE);
+  // Winner data survives arena churn after the release.
+  uint64_t size = 0;
+  const uint8_t* r = rt_store_get(h, winner_key, &size);
+  CHECK(r != nullptr && size == payload.size());
+  std::vector<uint8_t> filler(32 * 1024, 0xcc);
+  for (uint32_t i = 0; i < 64; i++) {
+    uint8_t k[kKeySize];
+    make_key(k, i, 123);
+    int rc = rt_store_put(h, k, filler.data(), filler.size());
+    CHECK(rc == 0 || rc == -2);
+  }
+  CHECK(std::memcmp(r, payload.data(), payload.size()) == 0);
+  rt_store_release(h, winner_key);
+  rt_store_close(h, 1);
+}
+
 void test_concurrent_hammer() {
   // The TSan target: N threads over one arena doing put/get/delete on
   // overlapping key ranges; invariants checked at the end.
@@ -243,6 +346,8 @@ int main() {
   test_pin_deferred_free();
   test_create_seal_abort();
   test_repair_after_torn_state();
+  test_repair_prefers_sealed_pinned();
+  test_repair_pinned_loser_stays_reserved();
   test_concurrent_hammer();
   std::printf("shm_store_test: all OK\n");
   return 0;
