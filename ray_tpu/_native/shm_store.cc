@@ -54,6 +54,8 @@ struct FreeBlock {
   uint64_t next;  // offset of next free block (0 = end)
 };
 
+constexpr uint64_t kMaxReserved = 64;  // crash-repair reservations
+
 struct StoreHeader {
   uint64_t magic;
   uint64_t capacity;       // data arena bytes
@@ -61,6 +63,14 @@ struct StoreHeader {
   uint64_t free_head;      // offset of first free block (arena-relative+1; 0=none)
   uint64_t used_bytes;
   uint64_t num_objects;
+  // Byte ranges permanently withheld from the allocator: repair found a
+  // pinned slot losing an overlap conflict, so a surviving reader still
+  // maps these bytes while another (winning) slot may own a subrange.
+  // arena_free clips every freed extent against this list — even the
+  // winner's own later delete cannot recycle a reserved byte.
+  uint64_t reserved_count;
+  uint64_t reserved_off[kMaxReserved];
+  uint64_t reserved_size[kMaxReserved];
   pthread_mutex_t mutex;
 };
 
@@ -154,9 +164,10 @@ uint64_t arena_alloc(Store* s, uint64_t size, uint64_t* actual_out) {
   return UINT64_MAX;
 }
 
-// Return an extent to the free list, coalescing with neighbors. `size` must
-// be the exact alloc_size recorded at allocation time. Caller holds the mutex.
-void arena_free(Store* s, uint64_t offset, uint64_t size) {
+// Link one extent into the free list, coalescing with neighbors. Callers
+// outside repair go through arena_free (which clips reservations first).
+// Caller holds the mutex.
+void arena_free_raw(Store* s, uint64_t offset, uint64_t size) {
   StoreHeader* h = header(s);
   h->used_bytes -= size;
   // Insert sorted by offset, then coalesce.
@@ -188,6 +199,54 @@ void arena_free(Store* s, uint64_t offset, uint64_t size) {
     blk->size += nxt->size;
     blk->next = nxt->next;
   }
+}
+
+// Return an extent to the free list, withholding any subrange on the
+// crash-repair reservation list: a reserved byte is still mapped by a
+// surviving reader of a conflict-losing slot, so even the legitimate
+// owner's delete must not let the allocator recycle it. Reserved slivers
+// stay counted in used_bytes (a bounded leak until the arena is
+// recreated). Caller holds the mutex.
+void arena_free(Store* s, uint64_t offset, uint64_t size) {
+  StoreHeader* h = header(s);
+  if (h->reserved_count == 0) {
+    arena_free_raw(s, offset, size);
+    return;
+  }
+  // Subtract each reserved range from the piece set, then free what is
+  // left. Piece count is bounded by reservations + 1.
+  uint64_t ps[kMaxReserved + 1];
+  uint64_t pe[kMaxReserved + 1];
+  uint64_t np = 1;
+  ps[0] = offset;
+  pe[0] = offset + size;
+  for (uint64_t i = 0; i < h->reserved_count && np <= kMaxReserved; i++) {
+    uint64_t ro = h->reserved_off[i];
+    uint64_t re = ro + h->reserved_size[i];
+    uint64_t cur_np = np;
+    for (uint64_t j = 0; j < cur_np; j++) {
+      if (pe[j] <= ro || ps[j] >= re) continue;  // disjoint
+      uint64_t a0 = ps[j], a1 = pe[j];
+      if (a0 < ro) {
+        pe[j] = ro;  // keep the left remainder in place
+      } else {
+        ps[j] = pe[j] = 0;  // fully covered on the left side
+      }
+      if (a1 > re && np <= kMaxReserved) {  // right remainder
+        ps[np] = re;
+        pe[np] = a1;
+        np++;
+      }
+    }
+  }
+  uint64_t freed = 0;
+  for (uint64_t j = 0; j < np; j++) {
+    if (pe[j] > ps[j] && pe[j] - ps[j] >= sizeof(FreeBlock)) {
+      arena_free_raw(s, ps[j], pe[j] - ps[j]);
+      freed += pe[j] - ps[j];
+    }
+  }
+  (void)freed;  // clipped bytes intentionally remain in used_bytes
 }
 
 }  // namespace
@@ -281,7 +340,7 @@ static void repair_store(Store* s) {
     uint64_t size;
     Slot* slot;
   };
-  Extent* exts = new Extent[kTableSize];
+  Extent* exts = new Extent[kTableSize + kMaxReserved];
   uint64_t n = 0;
   uint64_t sealed = 0;
   for (uint32_t i = 0; i < kTableSize; i++) {
@@ -335,6 +394,18 @@ static void repair_store(Store* s) {
       e.slot->alloc_size = 0;  // release must never free these bytes
       e.slot->size = 0;
       resv[n_resv++] = e;  // extent (by value) stays space-reserved
+      // Persist the reservation: a WINNING slot may own an overlapping
+      // subrange, and its own later delete must not recycle bytes this
+      // loser's surviving reader still maps — arena_free clips against
+      // this list. If the list is full, fall back to the in-walk
+      // reservation only (the residual winner-delete hazard returns for
+      // that extent; 64 torn-pinned extents in one arena lifetime is
+      // already deep in crash-of-crashes territory).
+      if (h->reserved_count < kMaxReserved) {
+        h->reserved_off[h->reserved_count] = e.off;
+        h->reserved_size[h->reserved_count] = e.size;
+        h->reserved_count++;
+      }
     } else {
       e.slot->state = SLOT_TOMBSTONE;
     }
@@ -356,12 +427,20 @@ static void repair_store(Store* s) {
     }
     if (!drop_cur) exts[kept++] = exts[i];
   }
-  // Fold reserved extents back in for the free-list complement and
-  // re-sort; reserved ranges may overlap winners, so walk the union
-  // with a monotonic cursor.
+  // Fold reserved extents back in for the free-list complement — both
+  // this repair's (resv) and any persisted by earlier repairs (header
+  // list; slotless) — and re-sort; reserved ranges may overlap winners,
+  // so walk the union with a monotonic cursor.
   for (uint64_t i = 0; i < n_resv; i++) exts[kept + i] = resv[i];
   uint64_t m = kept + n_resv;
   delete[] resv;
+  for (uint64_t i = 0; i < h->reserved_count && m < kTableSize + kMaxReserved;
+       i++) {
+    if (h->reserved_size[i] > 0 && h->reserved_off[i] < h->capacity &&
+        h->reserved_size[i] <= h->capacity - h->reserved_off[i]) {
+      exts[m++] = {h->reserved_off[i], h->reserved_size[i], nullptr};
+    }
+  }
   for (uint64_t i = 1; i < m; i++) {
     Extent e = exts[i];
     uint64_t j = i;
@@ -396,7 +475,7 @@ static void repair_store(Store* s) {
   h->free_head = free_head;
   h->used_bytes = used;
   for (uint64_t i = 0; i < m; i++) {
-    if (exts[i].slot->state == SLOT_SEALED) sealed++;
+    if (exts[i].slot && exts[i].slot->state == SLOT_SEALED) sealed++;
   }
   h->num_objects = sealed;
   delete[] exts;

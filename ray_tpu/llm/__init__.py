@@ -1,0 +1,21 @@
+"""TPU-native LLM serving: continuous batching over a jitted decode loop.
+
+The reference has no on-device serving path — its Serve batches at the
+request level (``/root/reference/python/ray/serve/batching.py``) and the
+replica runs arbitrary Python (``serve/_private/replica.py``). Here the
+replica hosts a compiled model: a slot-based KV cache where requests
+join free slots mid-flight, finished sequences leave without stalling
+the batch, and prefill runs chunked alongside decode (SURVEY §7.2
+step 9).
+"""
+
+from .engine import GenerationResult, RequestHandle, SlotEngine
+from .serve import LLMServer, build_llm_app
+
+__all__ = [
+    "SlotEngine",
+    "RequestHandle",
+    "GenerationResult",
+    "LLMServer",
+    "build_llm_app",
+]

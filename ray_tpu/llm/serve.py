@@ -1,0 +1,121 @@
+"""Serve deployment hosting a :class:`SlotEngine` — the on-TPU LLM
+serving path.
+
+A replica owns one compiled model + KV-slot pool; HTTP requests join
+free slots mid-flight and stream tokens back over the proxy's chunked
+path. Request schema (POST body JSON):
+
+    {"prompt": [token ids...], "max_tokens": 64, "temperature": 0.0,
+     "eos_id": null, "stream": false}
+
+Responses: ``{"tokens": [...], "finish_reason": ..., "prompt_len": N}``
+or, with ``stream: true``, one JSON token-id per chunk line.
+
+Reference analog: ``/root/reference/python/ray/serve/_private/replica.py``
+(replica request plane) — then beyond it: the reference has no
+accelerator-resident serving loop at all.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import jax
+
+from ..models import llama
+from .engine import SlotEngine
+
+
+def _build_params(model: str, seed: int,
+                  checkpoint_path: Optional[str] = None):
+    cfg = llama.CONFIGS[model]
+    if checkpoint_path:
+        from ..train.checkpoint import restore_arrays
+
+        params = restore_arrays(checkpoint_path)
+    else:
+        params, _ = llama.init_params(jax.random.PRNGKey(seed), cfg)
+    if cfg.dtype is not None:
+        params = jax.tree.map(lambda x: x.astype(cfg.dtype), params)
+    return params, cfg
+
+
+class LLMServer:
+    """Deployment class: one engine per replica, asyncio request plane.
+
+    The engine thread drives the TPU; handlers only bridge tokens into
+    the replica's event loop, so hundreds of concurrent streams cost one
+    queue hop each, never a device touch.
+    """
+
+    def __init__(self, model: str = "llama-tiny", num_slots: int = 8,
+                 chunk: int = 64, seed: int = 0,
+                 checkpoint_path: Optional[str] = None,
+                 default_max_tokens: int = 64):
+        params, cfg = _build_params(model, seed, checkpoint_path)
+        self.default_max_tokens = default_max_tokens
+        self.engine = SlotEngine(params, cfg, num_slots=num_slots,
+                                 chunk=chunk, seed=seed)
+        self.engine.warmup()  # compile before the replica is routable
+        self.engine.start()
+
+    def __del__(self):
+        try:
+            self.engine.stop()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    async def __call__(self, payload):
+        if not isinstance(payload, dict) or "prompt" not in payload:
+            return {"error": "body must be JSON with a 'prompt' "
+                             "token-id list"}
+        prompt = payload["prompt"]
+        max_tokens = int(payload.get("max_tokens",
+                                     self.default_max_tokens))
+        temperature = float(payload.get("temperature", 0.0))
+        eos_id = payload.get("eos_id")
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        handle = self.engine.submit(
+            prompt, max_new=max_tokens, temperature=temperature,
+            eos_id=None if eos_id is None else int(eos_id),
+            on_token=lambda t: loop.call_soon_threadsafe(q.put_nowait, t))
+        if payload.get("stream"):
+            async def token_stream():
+                while True:
+                    tok = await q.get()
+                    if tok is None:
+                        if handle.error is not None:
+                            raise handle.error
+                        return
+                    yield tok
+
+            return token_stream()
+        while True:
+            if await q.get() is None:
+                break
+        if handle.error is not None:
+            raise handle.error
+        res = handle.result(timeout=0)
+        return {"tokens": res.tokens, "finish_reason": res.finish_reason,
+                "prompt_len": res.prompt_len}
+
+    def stats(self) -> dict:
+        return {
+            "tokens_generated": self.engine.tokens_generated,
+            "requests_completed": self.engine.requests_completed,
+            "num_slots": self.engine.num_slots,
+        }
+
+
+def build_llm_app(model: str = "llama-tiny", num_slots: int = 8,
+                  chunk: int = 64, seed: int = 0,
+                  checkpoint_path: Optional[str] = None,
+                  name: str = "llm", **deploy_opts):
+    """Build a Serve application for ``serve.run`` hosting the engine."""
+    from ..serve import deployment
+
+    dep = deployment(LLMServer, name=name, **deploy_opts)
+    return dep.bind(model=model, num_slots=num_slots, chunk=chunk,
+                    seed=seed, checkpoint_path=checkpoint_path)

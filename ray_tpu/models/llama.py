@@ -48,6 +48,10 @@ CONFIGS = {
                               d_mlp=172, dtype=jnp.float32, remat=False),
     "llama2-13b": LlamaConfig(num_layers=40, num_heads=40, num_kv_heads=40,
                               d_model=5120, d_mlp=13824),
+    # TinyLlama-1.1B geometry — the serve-bench model: fits one v5e chip
+    # in bf16 (~2.2GB params) with an 8-slot KV cache to spare.
+    "llama-1b": LlamaConfig(num_layers=22, num_heads=32, num_kv_heads=4,
+                            d_model=2048, d_mlp=5632, max_seq=2048),
 }
 
 
@@ -181,56 +185,172 @@ def init_kv_cache(cfg: LlamaConfig, batch: int):
     }
 
 
+def _gqa_cache_attention(q, k_cache, v_cache, mask, cfg: LlamaConfig):
+    """Grouped-query attention of q against a full cache, without
+    materializing the repeated KV heads.
+
+    q: [B, H, C, hd]; k_cache/v_cache: [B, Hkv, S, hd]; mask broadcastable
+    to [B, Hkv, G, C, S]. Returns [B, C, D].
+    """
+    b, h, c, hd = q.shape
+    hkv = cfg.num_kv_heads
+    g = h // hkv
+    scale = 1.0 / math.sqrt(hd)
+    qg = q.reshape(b, hkv, g, c, hd)
+    # bf16 operands + fp32 accumulation: an explicit .astype(f32) here
+    # would materialize an fp32 copy of the whole KV cache every step —
+    # at decode time the cache read IS the bandwidth bill.
+    scores = jnp.einsum("bkgcd,bksd->bkgcs", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bkgcs,bksd->bkgcd", probs.astype(v_cache.dtype),
+                   v_cache)
+    return o.reshape(b, h, c, hd).transpose(0, 2, 1, 3).reshape(
+        b, c, cfg.d_model)
+
+
+def _cache_layer_step(x, p, cfg: LlamaConfig, positions, kv_mask,
+                      write_kv, attend_view=None):
+    """Shared per-layer transformer block for every KV-cache path
+    (single-position decode, per-slot decode, chunked prefill) — the
+    paths differ ONLY in how new K/V lands in the cache (``write_kv``)
+    and which cache view attention reads (``attend_view``).
+
+    x: [B, T, D]. Returns (x, k_cache, v_cache).
+    """
+    b, t, _ = x.shape
+    h, hd, hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
+    y = rms_norm(x, p["attn_norm"])
+    q = (y @ p["wq"].astype(y.dtype)).reshape(b, t, h, hd).transpose(
+        0, 2, 1, 3)
+    k_new = (y @ p["wk"].astype(y.dtype)).reshape(
+        b, t, hkv, hd).transpose(0, 2, 1, 3)
+    v_new = (y @ p["wv"].astype(y.dtype)).reshape(
+        b, t, hkv, hd).transpose(0, 2, 1, 3)
+    q = rope(q, positions, cfg.rope_theta)
+    k_new = rope(k_new, positions, cfg.rope_theta)
+    k_cache, v_cache = write_kv(k_new, v_new)
+    k_att, v_att = ((k_cache, v_cache) if attend_view is None
+                    else attend_view(k_cache, v_cache))
+    o = _gqa_cache_attention(q, k_att, v_att, kv_mask, cfg)
+    x = x + o @ p["wo"].astype(o.dtype)
+    y = rms_norm(x, p["ffn_norm"])
+    gate = jax.nn.silu(y @ p["w_gate"].astype(y.dtype))
+    up = y @ p["w_up"].astype(y.dtype)
+    x = x + (gate * up) @ p["w_down"].astype(y.dtype)
+    return x, k_cache, v_cache
+
+
+def _lm_head(x, params, cfg: LlamaConfig):
+    """[N, D] hidden states -> [N, vocab] fp32 logits."""
+    x = rms_norm(x, params["final_norm"])
+    return jnp.einsum("bd,vd->bv", x, params["wte"].astype(cfg.dtype),
+                      preferred_element_type=jnp.float32)
+
+
 def decode_step(params, cache, tokens, pos, cfg: LlamaConfig):
     """One decode step: tokens [B] at position ``pos`` (scalar int array).
 
     Returns (logits [B, vocab], new_cache). Static shapes; masked attention
     over the cache prefix.
     """
-    b = tokens.shape[0]
-    h, hd, hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
     x = params["wte"][tokens].astype(cfg.dtype)[:, None, :]  # [B,1,D]
     positions = jnp.full((1,), pos)
+    kv_mask = jnp.arange(cfg.max_seq)[None, None, None, None, :] <= pos
 
-    def layer_step(carry, inputs):
-        x = carry
-        layer_params, k_cache, v_cache = inputs
-        p = layer_params
-        y = rms_norm(x, p["attn_norm"])
-        q = (y @ p["wq"].astype(y.dtype)).reshape(b, 1, h, hd).transpose(
-            0, 2, 1, 3)
-        k_new = (y @ p["wk"].astype(y.dtype)).reshape(b, 1, hkv, hd).transpose(
-            0, 2, 1, 3)
-        v_new = (y @ p["wv"].astype(y.dtype)).reshape(b, 1, hkv, hd).transpose(
-            0, 2, 1, 3)
-        q = rope(q, positions, cfg.rope_theta)
-        k_new = rope(k_new, positions, cfg.rope_theta)
-        k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k_new, pos, 2)
-        v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v_new, pos, 2)
-        k = _repeat_kv(k_cache, h // hkv)
-        v = _repeat_kv(v_cache, h // hkv)
-        scale = 1.0 / math.sqrt(hd)
-        logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                            k.astype(jnp.float32)) * scale
-        mask = jnp.arange(cfg.max_seq)[None, None, None, :] <= pos
-        logits = jnp.where(mask, logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1)
-        o = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
-        o = o.transpose(0, 2, 1, 3).reshape(b, 1, cfg.d_model)
-        x = x + o @ p["wo"].astype(o.dtype)
-        y = rms_norm(x, p["ffn_norm"])
-        gate = jax.nn.silu(y @ p["w_gate"].astype(y.dtype))
-        up = y @ p["w_up"].astype(y.dtype)
-        x = x + (gate * up) @ p["w_down"].astype(y.dtype)
-        return x, (k_cache, v_cache)
+    def layer_step(x, inputs):
+        p, k_cache, v_cache = inputs
+
+        def write(kn, vn):
+            return (jax.lax.dynamic_update_slice_in_dim(k_cache, kn, pos, 2),
+                    jax.lax.dynamic_update_slice_in_dim(v_cache, vn, pos, 2))
+
+        x, k2, v2 = _cache_layer_step(x, p, cfg, positions, kv_mask, write)
+        return x, (k2, v2)
 
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["blocks"], cache["k"], cache["v"])
     )
-    x = rms_norm(x[:, 0], params["final_norm"])
-    logits = jnp.einsum("bd,vd->bv", x, params["wte"].astype(cfg.dtype),
-                        preferred_element_type=jnp.float32)
-    return logits, {"k": new_k, "v": new_v}
+    return _lm_head(x[:, 0], params, cfg), {"k": new_k, "v": new_v}
+
+
+def decode_slots(params, cache, tokens, pos, cfg: LlamaConfig):
+    """One decode step with PER-SLOT positions — the continuous-batching
+    inner loop (reference intent: serve/_private/replica.py request plane
+    + serve/batching.py, re-designed as a static-shape TPU program).
+
+    Each cache slot b holds an independent sequence at its own position
+    ``pos[b]``; requests join/leave slots between steps without touching
+    the compiled program. tokens [B] int32, pos [B] int32 (the position
+    the new token is written at). Returns (logits [B, vocab] fp32,
+    new_cache). Idle slots should be parked at pos = max_seq - 1: the
+    garbage K/V they write is always overwritten by a later occupant
+    before that position is attended.
+    """
+    x = params["wte"][tokens].astype(cfg.dtype)[:, None, :]  # [B,1,D]
+    positions = pos[:, None]  # [B,1] — per-slot rotary phase
+    kv_mask = (jnp.arange(cfg.max_seq)[None, None, None, None, :]
+               <= pos[:, None, None, None, None])
+
+    def layer_step(x, inputs):
+        p, k_cache, v_cache = inputs
+        # Per-slot scatter: slot b writes its token's K/V at pos[b].
+        upd = jax.vmap(
+            lambda c, n, p_: jax.lax.dynamic_update_slice_in_dim(
+                c, n, p_, 1))
+
+        def write(kn, vn):
+            return upd(k_cache, kn, pos), upd(v_cache, vn, pos)
+
+        x, k2, v2 = _cache_layer_step(x, p, cfg, positions, kv_mask, write)
+        return x, (k2, v2)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["blocks"], cache["k"], cache["v"]))
+    return _lm_head(x[:, 0], params, cfg), {"k": new_k, "v": new_v}
+
+
+def prefill_chunk(params, cache, tokens, slot, p0, cfg: LlamaConfig):
+    """Write one prompt chunk into ``slot``'s KV pages and return the
+    chunk logits — chunked prefill that interleaves with ``decode_slots``
+    so a long prompt never stalls in-flight decodes.
+
+    tokens [C] int32 (tail padding allowed — padded positions write
+    garbage K/V beyond the prompt which later writes always overwrite
+    before it is attended), slot/p0 scalar int32. Returns
+    (logits [C, vocab] fp32, new_cache).
+    """
+    h, hd, hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
+    c = tokens.shape[0]
+    x = params["wte"][tokens].astype(cfg.dtype)[None]  # [1,C,D]
+    positions = (p0 + jnp.arange(c))[None, :]  # [1,C]
+    # Query at chunk offset i (global p0+i) sees cache keys <= p0+i.
+    kv_mask = (jnp.arange(cfg.max_seq)[None, None, None, None, :]
+               <= positions[0][None, None, None, :, None])
+
+    def layer_step(x, inputs):
+        p, k_cache, v_cache = inputs
+
+        def write(kn, vn):
+            return (jax.lax.dynamic_update_slice(k_cache, kn,
+                                                 (slot, 0, p0, 0)),
+                    jax.lax.dynamic_update_slice(v_cache, vn,
+                                                 (slot, 0, p0, 0)))
+
+        def view(kc, vc):
+            return (jax.lax.dynamic_slice(
+                        kc, (slot, 0, 0, 0), (1, hkv, cfg.max_seq, hd)),
+                    jax.lax.dynamic_slice(
+                        vc, (slot, 0, 0, 0), (1, hkv, cfg.max_seq, hd)))
+
+        x, k2, v2 = _cache_layer_step(x, p, cfg, positions, kv_mask,
+                                      write, view)
+        return x, (k2, v2)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["blocks"], cache["k"], cache["v"]))
+    return _lm_head(x[0], params, cfg), {"k": new_k, "v": new_v}
 
 
 def generate(params, prompt_tokens, cfg: LlamaConfig, max_new: int = 32,

@@ -246,11 +246,14 @@ void test_repair_pinned_loser_stays_reserved() {
   loser->alloc_size = kAlign;
   loser->size = kAlign;
   loser->refcount = 1;  // a surviving reader maps it
+  uint64_t resv_off = loser->offset;
+  uint64_t resv_size = loser->alloc_size;
   repair_store(s);
   pthread_mutex_unlock(&header(s)->mutex);
   CHECK(winner->state == SLOT_SEALED);
   CHECK(loser->state == SLOT_PENDING_DELETE);
   CHECK(loser->alloc_size == 0);  // release must not arena_free
+  CHECK(header(s)->reserved_count == 1);  // persisted reservation
   uint64_t c0, used0, n0;
   rt_store_stats(h, &c0, &used0, &n0);
   CHECK(rt_store_release(h, loser_key) == 0);  // reader lets go
@@ -271,6 +274,21 @@ void test_repair_pinned_loser_stays_reserved() {
   }
   CHECK(std::memcmp(r, payload.data(), payload.size()) == 0);
   rt_store_release(h, winner_key);
+  // Now the hard case: deleting the WINNER frees its extent but must
+  // CLIP the reserved subrange — a surviving reader of the loser still
+  // maps those bytes, and the allocator may never hand them out again.
+  const uint8_t* resv_view =
+      reinterpret_cast<const uint8_t*>(arena(s) + resv_off);
+  std::vector<uint8_t> before(resv_view, resv_view + resv_size);
+  CHECK(rt_store_delete(h, winner_key) == 0);
+  for (uint32_t i = 0; i < 256; i++) {
+    uint8_t k[kKeySize];
+    make_key(k, i, 321);
+    int rc = rt_store_put(h, k, filler.data(), filler.size());
+    CHECK(rc == 0 || rc == -2);
+    if (rc == 0 && (i & 1)) rt_store_delete(h, k);
+  }
+  CHECK(std::memcmp(resv_view, before.data(), resv_size) == 0);
   rt_store_close(h, 1);
 }
 

@@ -1,0 +1,170 @@
+"""Continuous-batching engine tests: slot-batched output must match the
+single-request decode path token-for-token (VERDICT r4 item 1)."""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from ray_tpu.llm.engine import SlotEngine
+from ray_tpu.models import llama
+
+CFG = llama.CONFIGS["llama-tiny"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    p, _ = llama.init_params(jax.random.PRNGKey(0), CFG)
+    return p
+
+
+def reference_tokens(params, prompt, max_new):
+    """Single-request greedy reference via the plain generate() path."""
+    out = llama.generate(params, np.asarray([prompt], dtype=np.int32),
+                         CFG, max_new=max_new)
+    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+
+
+def drain(engine, handles, max_steps=500):
+    for _ in range(max_steps):
+        if all(h._done.is_set() for h in handles):
+            return
+        engine.step()
+    raise AssertionError("engine did not finish in max_steps")
+
+
+def test_single_request_matches_generate(params):
+    prompt = [3, 141, 59, 26, 5]
+    engine = SlotEngine(params, CFG, num_slots=4, chunk=8)
+    h = engine.submit(prompt, max_new=12)
+    drain(engine, [h])
+    res = h.result(timeout=0)
+    assert res.tokens == reference_tokens(params, prompt, 12)
+    assert res.finish_reason == "length"
+    assert res.prompt_len == len(prompt)
+
+
+def test_chunked_prefill_matches_generate(params):
+    # Prompt much longer than the chunk: 23 tokens / chunk 4 -> 6 chunks
+    # with a ragged tail.
+    rng = np.random.default_rng(7)
+    prompt = [int(t) for t in rng.integers(1, CFG.vocab_size, size=23)]
+    engine = SlotEngine(params, CFG, num_slots=2, chunk=4)
+    h = engine.submit(prompt, max_new=8)
+    drain(engine, [h])
+    assert h.result(timeout=0).tokens == reference_tokens(params, prompt, 8)
+
+
+def test_staggered_joins_token_for_token(params):
+    """Requests joining mid-flight must not perturb earlier slots."""
+    rng = np.random.default_rng(11)
+    prompts = [[int(t) for t in rng.integers(1, CFG.vocab_size, size=n)]
+               for n in (5, 17, 3, 9)]
+    max_news = [10, 6, 14, 8]
+    engine = SlotEngine(params, CFG, num_slots=3, chunk=8)
+    handles = []
+    # Stagger: submit one, run a few steps, submit the next. With 3
+    # slots and 4 requests the last request also exercises queueing.
+    for p, m in zip(prompts, max_news):
+        handles.append(engine.submit(p, max_new=m))
+        for _ in range(3):
+            engine.step()
+    drain(engine, handles)
+    for p, m, h in zip(prompts, max_news, handles):
+        assert h.result(timeout=0).tokens == reference_tokens(params, p, m), \
+            f"prompt len {len(p)} diverged under slot batching"
+
+
+def test_decode_block_matches_generate(params):
+    """K-step decode blocks (one device dispatch per K tokens) must be
+    token-for-token identical to single-step decoding."""
+    rng = np.random.default_rng(19)
+    prompts = [[int(t) for t in rng.integers(1, CFG.vocab_size, size=n)]
+               for n in (6, 13, 4)]
+    engine = SlotEngine(params, CFG, num_slots=2, chunk=8, decode_block=4)
+    handles = []
+    for p in prompts:
+        handles.append(engine.submit(p, max_new=10))
+        engine.step()
+    drain(engine, handles)
+    for p, h in zip(prompts, handles):
+        assert h.result(timeout=0).tokens == reference_tokens(params, p, 10)
+
+
+def test_decode_block_eos_overshoot_discarded(params):
+    prompt = [3, 141, 59, 26, 5]
+    ref = reference_tokens(params, prompt, 12)
+    eos = ref[4]
+    first = ref.index(eos)
+    engine = SlotEngine(params, CFG, num_slots=2, chunk=8, decode_block=8)
+    h = engine.submit(prompt, max_new=12, eos_id=eos)
+    drain(engine, [h])
+    res = h.result(timeout=0)
+    assert res.finish_reason == "stop"
+    assert res.tokens == ref[:first + 1]
+
+
+def test_slots_recycle_many_requests(params):
+    engine = SlotEngine(params, CFG, num_slots=2, chunk=8)
+    rng = np.random.default_rng(3)
+    handles = [engine.submit(
+        [int(t) for t in rng.integers(1, CFG.vocab_size, size=4)],
+        max_new=5) for _ in range(7)]
+    drain(engine, handles)
+    for h in handles:
+        assert len(h.result(timeout=0).tokens) == 5
+    assert engine.requests_completed == 7
+    assert engine.tokens_generated == 35
+
+
+def test_eos_stops_early(params):
+    prompt = [3, 141, 59, 26, 5]
+    ref = reference_tokens(params, prompt, 12)
+    eos = ref[4]  # a token the model provably emits
+    first = ref.index(eos)  # generation stops at its FIRST occurrence
+    engine = SlotEngine(params, CFG, num_slots=2, chunk=8)
+    h = engine.submit(prompt, max_new=12, eos_id=eos)
+    drain(engine, [h])
+    res = h.result(timeout=0)
+    assert res.finish_reason == "stop"
+    assert res.tokens == ref[:first + 1]  # includes the eos token
+
+
+def test_threaded_engine_with_streaming_iter(params):
+    engine = SlotEngine(params, CFG, num_slots=4, chunk=8).start()
+    try:
+        prompt = [9, 2, 77, 31]
+        ref = reference_tokens(params, prompt, 9)
+        streamed = []
+        h = engine.submit(prompt, max_new=9)
+        for tok in h:  # blocks as tokens arrive from the engine thread
+            streamed.append(tok)
+        assert streamed == ref
+        # concurrent submissions from several threads
+        results = {}
+
+        def worker(seed):
+            rng = np.random.default_rng(seed)
+            p = [int(t) for t in rng.integers(1, CFG.vocab_size, size=6)]
+            results[seed] = (p, engine.submit(p, max_new=7).result(60))
+
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for seed, (p, res) in results.items():
+            assert res.tokens == reference_tokens(params, p, 7)
+    finally:
+        engine.stop()
+
+
+def test_submit_validation(params):
+    engine = SlotEngine(params, CFG, num_slots=2, chunk=8)
+    with pytest.raises(ValueError):
+        engine.submit([], max_new=4)
+    with pytest.raises(ValueError):
+        engine.submit(list(range(1, 100)),
+                      max_new=CFG.max_seq)  # prompt+new > max_seq

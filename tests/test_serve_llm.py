@@ -1,0 +1,91 @@
+"""End-to-end LLM serving: engine replica behind serve.run + the HTTP
+proxy, with streamed tokens (VERDICT r4 item 1, SURVEY §7.2 step 9)."""
+
+import json
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def serve_instance(rt_shared):
+    from ray_tpu import serve
+
+    serve.start(http_port=18571)
+    yield serve
+    serve.shutdown()
+
+
+def _reference(prompt, max_new):
+    from ray_tpu.models import llama
+
+    cfg = llama.CONFIGS["llama-tiny"]
+    params, _ = llama.init_params(jax.random.PRNGKey(0), cfg)
+    out = llama.generate(params, np.asarray([prompt], dtype=np.int32),
+                         cfg, max_new=max_new)
+    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+
+
+def test_llm_app_http_and_stream(serve_instance):
+    from ray_tpu.llm import build_llm_app
+
+    app = build_llm_app(model="llama-tiny", num_slots=4, chunk=8,
+                        seed=0, name="llm")
+    serve_instance.run(app)
+    prompt = [3, 141, 59, 26, 5]
+    ref = _reference(prompt, 10)
+
+    body = json.dumps({"prompt": prompt, "max_tokens": 10}).encode()
+    req = urllib.request.Request("http://127.0.0.1:18571/llm", data=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        out = json.loads(r.read())
+    assert out["tokens"] == ref
+    assert out["finish_reason"] == "length"
+    assert out["prompt_len"] == len(prompt)
+
+    # streamed: chunked transfer, one JSON token per line, same tokens
+    body = json.dumps({"prompt": prompt, "max_tokens": 10,
+                       "stream": True}).encode()
+    req = urllib.request.Request("http://127.0.0.1:18571/llm", data=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        lines = [ln for ln in r.read().decode().splitlines() if ln]
+    assert [json.loads(ln) for ln in lines] == ref
+
+
+def test_llm_concurrent_http_requests(serve_instance):
+    """Several in-flight HTTP generations share the slot pool."""
+    import threading
+
+    from ray_tpu.llm import build_llm_app
+
+    app = build_llm_app(model="llama-tiny", num_slots=4, chunk=8,
+                        seed=0, name="llm2")
+    serve_instance.run(app)
+    rng = np.random.default_rng(5)
+    prompts = [[int(t) for t in rng.integers(1, 512, size=n)]
+               for n in (4, 9, 6, 12, 5, 7)]
+    outs = {}
+
+    def call(i):
+        body = json.dumps({"prompt": prompts[i],
+                           "max_tokens": 8}).encode()
+        req = urllib.request.Request(
+            "http://127.0.0.1:18571/llm2", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            outs[i] = json.loads(r.read())["tokens"]
+
+    threads = [threading.Thread(target=call, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, p in enumerate(prompts):
+        assert outs[i] == _reference(p, 8), f"request {i} diverged"
