@@ -27,7 +27,11 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x52545f53484d4152ull;  // "RT_SHMAR"
+// Layout version is part of the magic: bump the last byte whenever
+// StoreHeader changes so a new binary refuses a stale /dev/shm segment
+// instead of misreading the mutex offset ("RT_SHMA2" = v2: reserved
+// ranges added before the mutex).
+constexpr uint64_t kMagic = 0x52545f53484d4132ull;  // "RT_SHMA2"
 constexpr uint32_t kKeySize = 20;                   // ObjectID bytes
 constexpr uint32_t kTableSize = 1 << 16;            // object table slots
 constexpr uint64_t kAlign = 64;                     // allocation alignment
@@ -239,14 +243,12 @@ void arena_free(Store* s, uint64_t offset, uint64_t size) {
       }
     }
   }
-  uint64_t freed = 0;
   for (uint64_t j = 0; j < np; j++) {
     if (pe[j] > ps[j] && pe[j] - ps[j] >= sizeof(FreeBlock)) {
       arena_free_raw(s, ps[j], pe[j] - ps[j]);
-      freed += pe[j] - ps[j];
     }
   }
-  (void)freed;  // clipped bytes intentionally remain in used_bytes
+  // Clipped bytes intentionally remain counted in used_bytes.
 }
 
 }  // namespace

@@ -166,8 +166,9 @@ class SlotEngine:
         def prefill_step(params, cache, tokens, slot, p0, last_idx, temp,
                          key):
             logits, cache = llama.prefill_chunk(params, cache, tokens,
-                                                slot, p0, cfg)
-            tok = _sample(logits[last_idx][None], temp[None], key)[0]
+                                                slot, p0, cfg,
+                                                last_idx=last_idx)
+            tok = _sample(logits[None], temp[None], key)[0]
             return tok, cache
 
         # The cache is donated: XLA updates it in place, so a decode

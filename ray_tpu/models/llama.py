@@ -311,7 +311,8 @@ def decode_slots(params, cache, tokens, pos, cfg: LlamaConfig):
     return _lm_head(x[:, 0], params, cfg), {"k": new_k, "v": new_v}
 
 
-def prefill_chunk(params, cache, tokens, slot, p0, cfg: LlamaConfig):
+def prefill_chunk(params, cache, tokens, slot, p0, cfg: LlamaConfig,
+                  last_idx=None):
     """Write one prompt chunk into ``slot``'s KV pages and return the
     chunk logits — chunked prefill that interleaves with ``decode_slots``
     so a long prompt never stalls in-flight decodes.
@@ -319,7 +320,10 @@ def prefill_chunk(params, cache, tokens, slot, p0, cfg: LlamaConfig):
     tokens [C] int32 (tail padding allowed — padded positions write
     garbage K/V beyond the prompt which later writes always overwrite
     before it is attended), slot/p0 scalar int32. Returns
-    (logits [C, vocab] fp32, new_cache).
+    (logits, new_cache): logits is [vocab] for the single row
+    ``last_idx`` when given (the serving path — only the final prompt
+    position's logits are ever sampled, and a [C, vocab] lm_head per
+    chunk would be ~C x wasted FLOPs), else [C, vocab].
     """
     h, hd, hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
     c = tokens.shape[0]
@@ -350,7 +354,12 @@ def prefill_chunk(params, cache, tokens, slot, p0, cfg: LlamaConfig):
 
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["blocks"], cache["k"], cache["v"]))
-    return _lm_head(x[0], params, cfg), {"k": new_k, "v": new_v}
+    cache = {"k": new_k, "v": new_v}
+    if last_idx is not None:
+        row = jax.lax.dynamic_index_in_dim(x[0], last_idx, 0,
+                                           keepdims=False)
+        return _lm_head(row[None], params, cfg)[0], cache
+    return _lm_head(x[0], params, cfg), cache
 
 
 def generate(params, prompt_tokens, cfg: LlamaConfig, max_new: int = 32,

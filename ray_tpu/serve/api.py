@@ -301,6 +301,12 @@ class _AsyncHTTPProxy:
         self._host = host
         self._port = port
         self._handles: Dict[str, DeploymentHandle] = {}
+        # Per-deployment request coalescers (Nagle-style): concurrent
+        # requests that arrive while a replica RPC is in flight ride the
+        # NEXT batch — one actor hop serves many requests, with zero
+        # added latency for a lone request (batch of 1 goes immediately).
+        self._pending: Dict[str, Any] = {}
+        self._draining: set = set()
         self._loop = asyncio.new_event_loop()
         self._server = None
         self._started = threading.Event()
@@ -355,6 +361,75 @@ class _AsyncHTTPProxy:
         on_ref_ready(ref, lambda: loop.call_soon_threadsafe(_done))
         await asyncio.wait_for(fut, timeout)
         return get(ref, timeout=5)
+
+    async def _submit_coalesced(self, name: str, handle, args):
+        """Queue one request on the deployment's coalescer and await its
+        result. A drainer task per deployment pops whatever is pending
+        (up to 16) into ONE replica RPC; batches form naturally from
+        whatever arrives during the previous batch's round trip."""
+        import asyncio
+        from collections import deque
+
+        fut = self._loop.create_future()
+        q = self._pending.get(name)
+        if q is None:
+            q = self._pending[name] = deque()
+        q.append((args, fut))
+        if name not in self._draining:
+            self._draining.add(name)
+            asyncio.ensure_future(self._drain_pending(name, handle))
+        return await fut
+
+    async def _drain_pending(self, name: str, handle):
+        import asyncio
+
+        q = self._pending[name]
+        try:
+            while q:
+                batch = []
+                while q and len(batch) < 16:
+                    batch.append(q.popleft())
+                items = [(args, {}) for args, _ in batch]
+                try:
+                    assigned = handle._router.try_assign_batch(items)
+                    if assigned is None:
+                        # saturated / empty replica set: block off-loop
+                        assigned = await self._loop.run_in_executor(
+                            None, lambda it=items:
+                            handle._router.assign_batch(it))
+                except Exception as e:  # noqa: BLE001 — a dead replica
+                    # must 500 the batch, never strand its futures (the
+                    # drainer survives to serve later arrivals).
+                    for _, fut in batch:
+                        if not fut.done():
+                            fut.set_exception(e)
+                    continue
+                ref, replica, n = assigned
+                if n < len(batch):
+                    for entry in reversed(batch[n:]):
+                        q.appendleft(entry)
+                    batch = batch[:n]
+                # distribute concurrently; keep draining new arrivals
+                asyncio.ensure_future(
+                    self._distribute(ref, replica, batch))
+        finally:
+            self._draining.discard(name)
+
+    async def _distribute(self, ref, replica, batch):
+        try:
+            results = await self._aget(ref, 60)
+        except Exception as e:  # noqa: BLE001 — replica died mid-batch
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        for (_, fut), res in zip(batch, results):
+            if fut.done():
+                continue
+            if res[0] == "err":
+                fut.set_exception(RuntimeError(res[1]))
+            else:
+                fut.set_result((res[1], replica))
 
     async def _serve_conn(self, reader, writer):
         try:
@@ -432,19 +507,9 @@ class _AsyncHTTPProxy:
                     return True
                 handle = DeploymentHandle(name)
                 self._handles[name] = handle
-            # Fast path: submit inline on the event loop when a slot is
-            # free (the common case — saves a thread-pool hop per
-            # request); only saturated deployments take the off-loop
-            # blocking assign so they don't stall other connections.
             args = () if payload is None else (payload,)
-            assigned = handle._router.try_assign_with_replica(
-                None, args, {})
-            if assigned is None:
-                assigned = await self._loop.run_in_executor(
-                    None, lambda: handle._router.assign_with_replica(
-                        None, args, {}))
-            ref, replica = assigned
-            result = await self._aget(ref, 60)
+            result, replica = await self._submit_coalesced(
+                name, handle, args)
         except Exception as e:  # noqa: BLE001
             try:
                 self._write_simple(

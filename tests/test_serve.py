@@ -70,14 +70,24 @@ def test_multiple_replicas(serve_instance):
     class WhoAmI:
         def __call__(self, _=None):
             import os
+            import time
 
+            # Hold the slot long enough that in-flight load genuinely
+            # accumulates past the router's slack during the burst —
+            # instant returns would let completions race submissions
+            # and keep every request on one replica.
+            time.sleep(0.05)
             return os.getpid()
 
     handle = serve.run(WhoAmI.bind())
     from ray_tpu.core import get
 
-    pids = {get(handle.remote(), timeout=30) for _ in range(12)}
-    assert len(pids) >= 2  # round-robin across replicas
+    # Routing is sticky-with-slack: idle sequential traffic deliberately
+    # stays on one hot replica, but CONCURRENT load beyond the slack
+    # (Router._slack = 16) must spill across the set.
+    refs = [handle.remote() for _ in range(30)]
+    pids = set(get(refs, timeout=60))
+    assert len(pids) >= 2  # load spreads across replicas
 
     deps = serve.list_deployments()
     assert deps["WhoAmI"]["num_replicas"] == 3
