@@ -155,7 +155,8 @@ def main():
     # subprocess: the TPU-tunneled parent's resident device state and
     # axon-attached workers would skew pure host numbers.
     for key, fn_name in (("core_microbench", "bench_core"),
-                         ("serve_bench", "bench_serve")):
+                         ("serve_bench", "bench_serve"),
+                         ("envelope", "bench_envelope")):
         try:
             result[key] = _run_host_bench_subprocess(fn_name)
         except Exception as e:
@@ -226,6 +227,109 @@ def bench_core() -> dict:
                 out[key + "_vs_memcpy"] = row["vs_memcpy"]
         else:
             out[key] = row["ops_per_s"]
+    return out
+
+
+def bench_envelope() -> dict:
+    """Scalability envelope, scaled to one box (reference:
+    release/benchmarks/README.md envelope — test_many_actors 10k on a
+    multi-node cluster, test_many_tasks, test_many_pgs, 1 GiB
+    broadcast). Here: 1000 live shared-process actors (multiplexed
+    hosts — process-per-actor cannot reach 1k on one core), 100k queued
+    tasks drained, 500 placement groups, and a 1 GiB object fetched on
+    4 daemon-process nodes over the chunked transfer plane."""
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu import (NodeAffinitySchedulingStrategy, placement_group,
+                         remove_placement_group)
+    from ray_tpu.cluster_utils import Cluster
+
+    out = {}
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 4})
+    try:
+        # ---- 1000 live actors (shared-process hosts)
+        @rt.remote(shared_process=True)
+        class Hold:
+            def ping(self):
+                return 1
+
+        n_act = 1000
+        t0 = time.perf_counter()
+        actors = [Hold.remote() for _ in range(n_act)]
+        assert sum(rt.get([a.ping.remote() for a in actors],
+                          timeout=900)) == n_act
+        dt = time.perf_counter() - t0
+        out["many_actors_n"] = n_act
+        out["many_actors_create_ping_s"] = round(dt, 1)
+        out["many_actors_per_s"] = round(n_act / dt, 1)
+        t0 = time.perf_counter()
+        rt.get([a.ping.remote() for a in actors], timeout=900)
+        out["alive_actor_pings_per_s"] = round(
+            n_act / (time.perf_counter() - t0), 1)
+        for a in actors:
+            rt.kill(a)
+        del actors
+
+        # ---- 100k queued tasks drained
+        @rt.remote
+        def noop():
+            return None
+
+        n_tasks = 100_000
+        t0 = time.perf_counter()
+        refs = [noop.remote() for _ in range(n_tasks)]
+        t_submit = time.perf_counter() - t0
+        rt.get(refs, timeout=1800)
+        t_total = time.perf_counter() - t0
+        out["many_tasks_n"] = n_tasks
+        out["many_tasks_submit_per_s"] = round(n_tasks / t_submit, 1)
+        out["many_tasks_e2e_per_s"] = round(n_tasks / t_total, 1)
+        del refs
+
+        # ---- 500 placement groups created + removed
+        n_pg = 500
+        t0 = time.perf_counter()
+        pgs = [placement_group([{"CPU": 0.001}]) for _ in range(n_pg)]
+        for pg in pgs:
+            assert pg.wait(60)
+        t_create = time.perf_counter() - t0
+        for pg in pgs:
+            remove_placement_group(pg)
+        out["many_pgs_n"] = n_pg
+        out["many_pgs_create_per_s"] = round(n_pg / t_create, 1)
+
+        # ---- 1 GiB broadcast to 4 daemon-process nodes
+        daemons = [cluster.add_node(num_cpus=1, remote=True)
+                   for _ in range(4)]
+        cluster.wait_for_nodes(timeout=120)
+        blob = np.ones((1 << 30,), np.uint8)  # 1 GiB
+        ref = rt.put(blob)
+
+        @rt.remote
+        def touch(x):
+            # Touch every page: len() alone would measure the zero-copy
+            # mmap attach, not a real read of the broadcast bytes.
+            import numpy as _np
+
+            return int(x[::4096].astype(_np.int64).sum()) + len(x)
+
+        t0 = time.perf_counter()
+        fetches = [
+            touch.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=nid.binary(), soft=False)).remote(ref)
+            for nid in daemons
+        ]
+        sizes = rt.get(fetches, timeout=600)
+        dt = time.perf_counter() - t0
+        assert all(s == (1 << 30) + (1 << 18) for s in sizes)
+        out["broadcast_nodes"] = len(daemons)
+        out["broadcast_gib_total"] = len(daemons)
+        out["broadcast_aggregate_GBps"] = round(len(daemons) / dt, 2)
+    finally:
+        cluster.shutdown()
     return out
 
 

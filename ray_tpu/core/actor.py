@@ -36,6 +36,11 @@ _DEFAULT_ACTOR_OPTIONS = dict(
     max_restarts=0,
     max_task_retries=0,
     max_concurrency=1,
+    # Shared-process actors multiplex many instances into a small pool
+    # of host workers (no dedicated OS process per actor) — for fleets
+    # of mostly-idle stateful actors. Restrictions: no dedicated
+    # process isolation (one bad actor can take its co-tenants down).
+    shared_process=False,
     concurrency_groups=None,
     name=None,
     namespace="default",
@@ -222,6 +227,7 @@ class ActorClass:
             actor_id=actor_id,
             max_restarts=opts["max_restarts"],
             max_concurrency=opts["max_concurrency"],
+            shared_process=bool(opts.get("shared_process")),
             concurrency_groups=opts.get("concurrency_groups"),
             name=opts["name"] or "",
             runtime_env=dict(opts["runtime_env"]) if opts.get("runtime_env") else None,

@@ -272,6 +272,17 @@ class RemoteWorkerPool:
         self._request_spawn(actor_id)
         return None
 
+    def get_shared_host(self, actor_id):
+        """Daemon pools have no multiplexed hosts (the worker pool lives
+        in another OS process): shared-process actors degrade to
+        dedicated workers on remote nodes. The runtime's lifecycle
+        branches key on ACTUAL hosting (worker.actor_ids membership),
+        so the dedicated paths apply naturally."""
+        return self.start_dedicated(actor_id)
+
+    def detach_shared(self, worker, actor_id) -> None:
+        pass
+
     def return_worker(self, worker: RemoteWorkerHandle) -> None:
         with self._lock:
             if worker.state == RemoteWorkerHandle.LEASED:

@@ -1012,7 +1012,9 @@ class Runtime:
             self._tasks[spec.task_id] = task_record
 
         def on_granted(node: NodeManager, worker: WorkerHandle):
-            node.pool.dedicate(worker, record.actor_id)
+            if not spec.shared_process:
+                # (shared hosts were attached by get_shared_host)
+                node.pool.dedicate(worker, record.actor_id)
             with self._lock:
                 record.node = node
                 record.worker = worker
@@ -1062,7 +1064,13 @@ class Runtime:
             record.in_flight = {}
             worker = record.worker
         if worker is not None:
-            worker.kill()  # ctor failed: reap the dedicated worker
+            if self._is_shared_hosted(record, worker):
+                worker.send(("destroy_actor", record.actor_id.hex()))
+                if record.node is not None:
+                    record.node.pool.detach_shared(worker,
+                                                   record.actor_id)
+            else:
+                worker.kill()  # ctor failed: reap the dedicated worker
         self._release_actor_resources(record)
         self.gcs.update_actor(record.actor_id, ActorState.DEAD,
                               death_cause=str(error))
@@ -1139,6 +1147,14 @@ class Runtime:
         if not ok:
             self._handle_worker_death(record.worker)
 
+    @staticmethod
+    def _is_shared_hosted(record, worker) -> bool:
+        """True when the actor is ACTUALLY multiplexed on a shared host
+        (vs a shared_process actor that degraded to a dedicated worker
+        on a daemon node, where the dedicated lifecycle paths apply)."""
+        return (record.creation_spec.shared_process
+                and record.actor_id in getattr(worker, "actor_ids", ()))
+
     def terminate_actor(self, actor_id: ActorID) -> None:
         """Graceful termination: drain queued methods, then exit the worker.
 
@@ -1167,7 +1183,17 @@ class Runtime:
                     actor_id, "actor terminated (handle out of scope)"))
         self._release_actor_resources(record)
         if worker is not None:
-            worker.send(("drain_exit",))
+            if self._is_shared_hosted(record, worker):
+                # The host outlives this actor: drop only the instance
+                # (queued methods already in the pipe run first — the
+                # worker processes its pipe FIFO).
+                worker.send(("destroy_actor",
+                             record.actor_id.hex()))
+                node = record.node
+                if node is not None:
+                    node.pool.detach_shared(worker, record.actor_id)
+            else:
+                worker.send(("drain_exit",))
 
     def _release_actor_resources(self, record: _ActorRecord) -> None:
         """Return the actor's reserved resources once it is DEAD for good.
@@ -1191,7 +1217,15 @@ class Runtime:
             if no_restart:
                 record.restarts_left = 0
             worker = record.worker
-        if worker is not None:
+        if worker is not None and self._is_shared_hosted(record, worker):
+            # Never kill a shared host for one tenant: evict the
+            # instance and run this actor's death path directly.
+            worker.send(("destroy_actor", actor_id.hex()))
+            node = record.node
+            if node is not None:
+                node.pool.detach_shared(worker, actor_id)
+            self._handle_actor_death(record)
+        elif worker is not None:
             # kill() marks the handle DEAD, which suppresses the pump
             # thread's death callback — run the FT path synchronously so
             # in-flight and subsequent calls fail deterministically.
@@ -1675,6 +1709,7 @@ class Runtime:
                 if (record is not None
                         and record.worker is not None
                         and record.worker.actor_id is None
+                        and not record.worker.actor_ids
                         and record.retries_left > 0):
                     victim = record
                     # Mark DEAD while still holding the lock: a worker that
@@ -1713,9 +1748,21 @@ class Runtime:
             actor_record = None
             if worker.actor_id is not None:
                 actor_record = self._actors.get(worker.actor_id)
+            # A dead SHARED host takes all its multiplexed actors down;
+            # each one goes through the normal death/restart FSM (a
+            # restart lands on a surviving or fresh shared host).
+            shared_records = [r for r in (self._actors.get(a)
+                                          for a in getattr(
+                                              worker, "actor_ids", ()))
+                              if r is not None]
         node = self.scheduler.get_node(worker.node_id)
         if node is not None and node.alive:
             worker.state = WorkerHandle.DEAD
+        if shared_records:
+            worker.actor_ids.clear()  # present: shared_records nonempty
+            for rec in shared_records:
+                self._handle_actor_death(rec)
+            return
         if actor_record is not None:
             self._handle_actor_death(actor_record)
             return

@@ -317,10 +317,17 @@ class ClusterScheduler:
                         continue
                     if lease.spec.task_type == TaskType.ACTOR_CREATION_TASK:
                         # Actors get dedicated workers outside the pool cap
-                        # (reference: WorkerPool dedicated-worker path).
-                        # Daemon-backed pools spawn asynchronously and
-                        # return None until the worker registers.
-                        worker = node.pool.start_dedicated(lease.spec.actor_id)
+                        # (reference: WorkerPool dedicated-worker path);
+                        # shared-process actors multiplex onto host
+                        # workers instead. Daemon-backed pools spawn
+                        # asynchronously and return None until the
+                        # worker registers.
+                        if getattr(lease.spec, "shared_process", False):
+                            worker = node.pool.get_shared_host(
+                                lease.spec.actor_id)
+                        else:
+                            worker = node.pool.start_dedicated(
+                                lease.spec.actor_id)
                         if worker is None:
                             remaining.append(lease)
                             continue

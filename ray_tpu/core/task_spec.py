@@ -64,6 +64,13 @@ class TaskSpec:
     actor_seq_no: int = 0
     max_restarts: int = 0
     max_concurrency: int = 1
+    # Shared-process ("lightweight") actor: hosted in a multiplexed
+    # worker alongside other such actors instead of a dedicated OS
+    # process — thousands of mostly-idle stateful actors per host (the
+    # reference's many-actors envelope needs a multi-node cluster for
+    # process count alone; worker_main already keys instances by
+    # actor id, so execution-side multiplexing is native).
+    shared_process: bool = False
     # method-group name -> max concurrent calls (reference: concurrency groups)
     concurrency_groups: Optional[Dict[str, int]] = None
     name: str = ""

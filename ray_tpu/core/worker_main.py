@@ -223,6 +223,13 @@ class WorkerRuntime:
                         # Graceful: already-queued tasks run first, then
                         # the loop stops (reference: __ray_terminate__).
                         self._task_queue.put(None)
+                    elif kind == "destroy_actor":
+                        # Shared-process actor eviction: rides the task
+                        # queue so queued methods drain first; the host
+                        # worker itself lives on.
+                        with self._route_lock:
+                            self._loop_pending += 1
+                        self._task_queue.put(msg)
         except (EOFError, OSError):
             self._shutdown.set()
             self._task_queue.put(None)
@@ -509,6 +516,37 @@ class WorkerRuntime:
                 self._loop_pending += 1
         self._task_queue.put(msg)
 
+    def _destroy_actor(self, actor_hex: str) -> None:
+        """Evict one shared-process actor instance; the worker lives on.
+        In-flight methods keep their instance reference and finish;
+        later arrivals fail with "actor instance not found"."""
+        self._actors.pop(actor_hex, None)
+        ex = self._actor_executors.pop(actor_hex, None)
+        if ex is not None:
+            ex.shutdown(wait=False)
+        for key in [k for k in self._group_executors
+                    if k[0] == actor_hex]:
+            self._group_executors.pop(key).shutdown(wait=False)
+        self._actor_method_groups.pop(actor_hex, None)
+        loop = self._actor_loops.pop(actor_hex, None)
+        if loop is not None:
+            # Stop only once idle: in-flight async methods still run on
+            # this loop (their executor threads block on
+            # run_coroutine_threadsafe(...).result()); stopping now
+            # would strand those futures and leak the blocked threads.
+            import asyncio
+
+            def _stop_when_idle():
+                if any(not t.done() for t in asyncio.all_tasks(loop)):
+                    loop.call_later(0.05, _stop_when_idle)
+                else:
+                    loop.stop()
+
+            try:
+                loop.call_soon_threadsafe(_stop_when_idle)
+            except Exception:  # noqa: BLE001 — loop already closed
+                pass
+
     def run_task_loop(self) -> None:
         reader = threading.Thread(target=self._reader_loop, daemon=True,
                                   name="worker-reader")
@@ -518,6 +556,11 @@ class WorkerRuntime:
             msg = self._task_queue.get()
             if msg is None:
                 break
+            if msg[0] == "destroy_actor":
+                with self._route_lock:
+                    self._loop_pending -= 1
+                self._destroy_actor(msg[1])
+                continue
             payload = msg[2]
             executor = None
             if TaskType(payload["task_type"]) == TaskType.ACTOR_TASK:

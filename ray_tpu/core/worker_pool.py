@@ -26,6 +26,7 @@ class WorkerHandle:
     IDLE = "IDLE"
     LEASED = "LEASED"
     DEDICATED = "DEDICATED"  # bound to an actor for its lifetime
+    SHARED = "SHARED"  # hosts MANY shared-process actors (multiplexed)
     DEAD = "DEAD"
 
     def __init__(self, worker_id: WorkerID, node_id: NodeID, process, conn,
@@ -36,6 +37,8 @@ class WorkerHandle:
         self.conn = conn
         self.state = WorkerHandle.IDLE
         self.actor_id = None
+        # shared-process hosting: ids of actors multiplexed on this worker
+        self.actor_ids: set = set()
         self.current_tasks: set = set()
         self.lease_expiry: float = 0.0
         self._send_lock = threading.Lock()
@@ -309,6 +312,48 @@ class WorkerPool:
             handle.actor_id = actor_id
         return handle
 
+    # Shared-process actor hosts: a small fixed set of SHARED workers
+    # multiplexing many lightweight actors each (least-populated pick).
+    MAX_SHARED_HOSTS = 4
+
+    def get_shared_host(self, actor_id) -> Optional[WorkerHandle]:
+        """Attach an actor to a shared host worker, spawning hosts
+        lazily up to MAX_SHARED_HOSTS. Returns None while a fresh host
+        is still registering (caller retries the lease)."""
+        def stack(hosts):
+            best = min(hosts, key=lambda w: len(w.actor_ids))
+            best.actor_ids.add(actor_id)
+            return best
+
+        with self._lock:
+            hosts = [w for w in self._workers.values()
+                     if w.state == WorkerHandle.SHARED and w.alive()]
+            if len(hosts) >= self.MAX_SHARED_HOSTS:
+                return stack(hosts)
+            # Below the host cap: prefer opening another host (spread)
+            # by claiming a prestarted idle worker; if none is idle
+            # right now, stack on an existing host rather than wait.
+            claimed = self._claim_idle_locked(WorkerHandle.SHARED)
+            if claimed is not None:
+                claimed.actor_ids.add(actor_id)
+                if not self._stopped.is_set() \
+                        and self._reserve_spawn_locked():
+                    threading.Thread(target=self._spawn_reserved,
+                                     daemon=True,
+                                     name="rt-pool-refill").start()
+                return claimed
+            if hosts:
+                return stack(hosts)
+        handle = self._start_worker()
+        with self._lock:
+            handle.state = WorkerHandle.SHARED
+            handle.actor_ids.add(actor_id)
+        return handle
+
+    def detach_shared(self, worker: WorkerHandle, actor_id) -> None:
+        with self._lock:
+            worker.actor_ids.discard(actor_id)
+
     def grow(self, n: int = 1) -> None:
         """Temporarily exceed pool size (blocked-worker compensation)."""
         with self._lock:
@@ -317,9 +362,11 @@ class WorkerPool:
             self._start_worker()
 
     def _alive(self) -> List[WorkerHandle]:
-        """Alive workers counted against the pool cap (excludes dedicated)."""
+        """Alive workers counted against the pool cap (excludes workers
+        bound to actors — dedicated and shared hosts)."""
         return [w for w in self._workers.values()
-                if w.alive() and w.state != WorkerHandle.DEDICATED]
+                if w.alive() and w.state not in (WorkerHandle.DEDICATED,
+                                                 WorkerHandle.SHARED)]
 
     def num_idle(self) -> int:
         with self._lock:

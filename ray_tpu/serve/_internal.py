@@ -216,11 +216,16 @@ class _Replica:
             final = []
             for tag, val in results:
                 if tag == "ok":
-                    if inspect.iscoroutine(val):
-                        val = await val
-                    if inspect.isgenerator(val) or inspect.isasyncgen(
-                            val):
-                        val = self._register_stream(val)
+                    try:
+                        if inspect.iscoroutine(val):
+                            val = await (asyncio.wait_for(
+                                val, self._timeout) if self._timeout
+                                else val)
+                        if inspect.isgenerator(val) or inspect.isasyncgen(
+                                val):
+                            val = self._register_stream(val)
+                    except Exception as e:  # noqa: BLE001 — isolation
+                        tag, val = "err", repr(e)
                 final.append((tag, val))
             return final
         finally:
