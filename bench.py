@@ -151,12 +151,18 @@ def main():
         result["serve_llm"] = bench_llm(on_tpu)
     except Exception as e:  # LLM bench must never break the MFU line
         result["serve_llm_error"] = repr(e)[:300]
+    gc.collect()
+    try:
+        result["long_context"] = bench_long_context(on_tpu)
+    except Exception as e:
+        result["long_context_error"] = repr(e)[:300]
     # Host-plane benches (core runtime, serve) run in a FRESH CPU-only
     # subprocess: the TPU-tunneled parent's resident device state and
     # axon-attached workers would skew pure host numbers.
     for key, fn_name in (("core_microbench", "bench_core"),
                          ("serve_bench", "bench_serve"),
-                         ("envelope", "bench_envelope")):
+                         ("envelope", "bench_envelope"),
+                         ("ring_parity", "bench_ring_parity")):
         try:
             result[key] = _run_host_bench_subprocess(fn_name)
         except Exception as e:
@@ -180,6 +186,12 @@ def _run_host_bench_subprocess(fn_name: str) -> dict:
     )
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # Virtual 8-device CPU mesh: bench_ring_parity (and any host bench
+    # touching jax.sharding) needs more than the 1 real core.
+    prev = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in prev:
+        env["XLA_FLAGS"] = (
+            prev + " --xla_force_host_platform_device_count=8").strip()
     with tempfile.NamedTemporaryFile(
             "w", suffix=".py", delete=False) as f:
         f.write(code)
@@ -577,6 +589,109 @@ def bench_llm(on_tpu: bool) -> dict:
         "tokens, greedy; end-to-end incl. chunked prefill")
     del engine, params
     gc.collect()
+    return out
+
+
+def bench_long_context(on_tpu: bool) -> dict:
+    """Long-context training MFU on one chip: GPT-2 355M with flash
+    attention at seq 4k/8k/16k, constant 16k tokens per step (VERDICT r4
+    item 5 — the MFU-vs-seq curve is the whole point of the flash
+    kernel: attention grows O(S^2) while the matmul backbone is linear,
+    so sustained MFU across the curve proves the kernel keeps the MXU
+    fed as the quadratic term takes over)."""
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel.mesh import MeshSpec
+    from ray_tpu.train.step import build_sharded_train
+
+    out = {}
+    base = gpt2.CONFIGS["gpt2-355m"]
+    points = ((4096, 4), (8192, 2), (16384, 1)) if on_tpu \
+        else ((512, 1),)
+    steps = 4 if on_tpu else 2
+    peak = 197e12 if on_tpu else 1e12
+    for seq, batch in points:
+        cfg = gpt2.GPT2Config(
+            vocab_size=base.vocab_size, max_seq=seq,
+            num_layers=base.num_layers, num_heads=base.num_heads,
+            d_model=base.d_model, dtype=jnp.bfloat16,
+            attention_impl="flash" if on_tpu else "reference",
+            remat=True, remat_policy="mem2" if on_tpu else "dots_attn",
+        )
+
+        def bf16_init(key, cfg=cfg):
+            params, axes = gpt2.init_params(key, cfg)
+            params = jax.tree.map(
+                lambda p: p.astype(jnp.bfloat16)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+            return params, axes
+
+        mesh = MeshSpec(dp=1).build()
+        sinit, sstep, _ = build_sharded_train(
+            bf16_init, lambda p, b, cfg=cfg: gpt2.loss_fn(p, b, cfg),
+            mesh, optimizer=optax.adafactor(learning_rate=1e-4),
+            master_fp32=False)
+        params, opt_state, step = sinit(jax.random.PRNGKey(0))
+        tokens = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (batch, seq + 1)), jnp.int32)
+        bd = {"tokens": tokens}
+        for _ in range(2):
+            params, opt_state, step, metrics = sstep(params, opt_state,
+                                                     step, bd)
+        float(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, step, metrics = sstep(params, opt_state,
+                                                     step, bd)
+        float(metrics["loss"])
+        dt = (time.perf_counter() - t0) / steps
+        tok_s = batch * seq / dt
+        mfu = tok_s * gpt2.flops_per_token(cfg, seq) / peak
+        out[f"mfu_seq{seq}"] = round(mfu * 100, 2)
+        out[f"tokens_per_s_seq{seq}"] = round(tok_s, 1)
+        del params, opt_state, metrics, tokens, bd, sstep, sinit
+        gc.collect()
+    out["detail"] = ("gpt2-355m bf16+adafactor, flash attention, mem2 "
+                     "remat, constant 16k tokens/step, ONE v5e chip")
+    return out
+
+
+def bench_ring_parity() -> dict:
+    """Ring attention (einsum AND flash-block bodies) vs full reference
+    at long sequence lengths on the virtual sp=4 CPU mesh — numeric
+    proof the sequence-parallel path computes the same attention the
+    single-chip flash kernel does (tolerance 1e-2 per the r4 target;
+    observed errors are ~1e-5)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.attention import mha_reference
+    from ray_tpu.parallel.mesh import MeshSpec
+    from ray_tpu.parallel.ring import ring_attention
+
+    out = {}
+    mesh = MeshSpec(sp=4).build(jax.devices()[:4])
+    for seq in (4096, 8192):
+        ks = jax.random.split(jax.random.PRNGKey(seq), 3)
+        q, k, v = (jax.random.normal(kk, (1, 2, seq, 64), jnp.float32)
+                   for kk in ks)
+        ref = mha_reference(q, k, v, causal=True)
+        for impl in ("einsum", "flash"):
+            got = ring_attention(q, k, v, mesh, causal=True,
+                                 batch_axes=(), heads_axis=None,
+                                 impl=impl)
+            err = float(jnp.max(jnp.abs(got - ref)))
+            out[f"ring_{impl}_vs_full_seq{seq}_max_err"] = round(err, 8)
+            assert err < 1e-2, f"{impl}@{seq}: {err}"
+        del q, k, v, ref
     return out
 
 

@@ -45,10 +45,14 @@ def _on_tpu() -> bool:
 # block). Shapes: q [B, H, Sq, D], k/v [B, H, Sk, D].
 # ---------------------------------------------------------------------------
 
-def mha_reference(q, k, v, causal: bool = True,
-                  scale: Optional[float] = None,
-                  q_offset: int = 0):
-    """Plain attention; ``q_offset`` shifts causal positions (ring steps)."""
+def mha_reference_with_lse(q, k, v, causal: bool = True,
+                           scale: Optional[float] = None,
+                           q_offset: int = 0):
+    """Reference attention returning (o, lse [B,H,Sq] fp32) — the
+    mergeable form ring attention's block steps need. ``q_offset``
+    shifts causal positions (ring steps). Fully-masked rows produce
+    lse ~= -1e30 (finite), so downstream logaddexp merges never see
+    inf-inf NaNs."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum(
@@ -59,11 +63,20 @@ def mha_reference(q, k, v, causal: bool = True,
         q_pos = jnp.arange(sq)[:, None] + q_offset
         k_pos = jnp.arange(sk)[None, :]
         logits = jnp.where(q_pos >= k_pos, logits, _NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum(
-        "bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
-        preferred_element_type=jnp.float32,
-    ).astype(q.dtype)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", (p / l).astype(v.dtype), v,
+                   preferred_element_type=jnp.float32).astype(q.dtype)
+    return o, (m + jnp.log(l))[..., 0]
+
+
+def mha_reference(q, k, v, causal: bool = True,
+                  scale: Optional[float] = None,
+                  q_offset: int = 0):
+    """Plain attention; ``q_offset`` shifts causal positions (ring steps)."""
+    return mha_reference_with_lse(q, k, v, causal=causal, scale=scale,
+                                  q_offset=q_offset)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -455,6 +468,17 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, res, do):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def _tileable(q, k, causal: bool, block_q: int, block_k: int):
+    """Clamp block sizes to the sequence and decide whether the pallas
+    kernels can tile this shape; (bq, bk, ok)."""
+    sq, sk = q.shape[2], k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    ok = not (sq % bq != 0 or sk % bk != 0
+              or (causal and bq % bk != 0 and bk % bq != 0))
+    return bq, bk, ok
+
+
 def flash_attention(q, k, v, causal: bool = True,
                     scale: Optional[float] = None,
                     block_q: int = 512, block_k: int = 512):
@@ -465,10 +489,8 @@ def flash_attention(q, k, v, causal: bool = True,
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    sq, sk = q.shape[2], k.shape[2]
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
-    if sq % bq != 0 or sk % bk != 0 or (causal and bq % bk != 0 and bk % bq != 0):
+    bq, bk, ok = _tileable(q, k, causal, block_q, block_k)
+    if not ok:
         return mha_reference(q, k, v, causal=causal, scale=scale)
     return _flash(q, k, v, causal, scale, bq, bk)
 
@@ -479,3 +501,22 @@ def attention(q, k, v, causal: bool = True, impl: str = "auto",
     if impl == "reference" or (impl == "auto" and not _on_tpu()):
         return mha_reference(q, k, v, causal=causal, scale=scale)
     return flash_attention(q, k, v, causal=causal, scale=scale)
+
+
+def attention_with_lse(q, k, v, causal: bool = True,
+                       scale: Optional[float] = None, impl: str = "auto",
+                       block_q: int = 512, block_k: int = 512):
+    """Attention returning (o, lse) — pallas flash forward on TPU,
+    reference path elsewhere. Forward-only contract (no custom vjp):
+    the ring TRAINING path uses the autodiff-able einsum body; this is
+    the serving/inference block used by ``ring_flash_attention_local``.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if impl == "reference" or (impl == "auto" and not _on_tpu()):
+        return mha_reference_with_lse(q, k, v, causal=causal, scale=scale)
+    bq, bk, ok = _tileable(q, k, causal, block_q, block_k)
+    if not ok:
+        return mha_reference_with_lse(q, k, v, causal=causal, scale=scale)
+    return _flash_fwd_pallas(q, k, v, causal, scale, bq, bk,
+                             interpret=not _on_tpu())

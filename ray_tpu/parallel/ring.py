@@ -80,19 +80,89 @@ def ring_attention_local(q, k, v, axis_name: str = "sp",
     return (acc / safe_l).astype(q.dtype)
 
 
+def ring_flash_attention_local(q, k, v, axis_name: str = "sp",
+                               causal: bool = True,
+                               scale: Optional[float] = None):
+    """Ring attention whose per-step block compute is the FLASH kernel
+    (``ops.attention``): each step runs one flash forward of the local Q
+    shard against the K/V shard currently held, and partial outputs
+    merge across steps through their log-sum-exp — mathematically the
+    same online-softmax as :func:`ring_attention_local`, but the inner
+    S_local x S_local work runs on the fused pallas block instead of a
+    materialized fp32 score matrix. Forward-only (serving / long-context
+    inference); training through ring attention uses the autodiff-able
+    einsum body above.
+
+    Three block modes per step under causal masking: the diagonal step
+    (src == rank) is plain causal flash; earlier shards (src < rank)
+    attend fully; later shards are skipped via lax.switch with an
+    lse of -1e30 so the merge weight is exactly 0.
+    """
+    from ..ops.attention import attention_with_lse
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    n = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def diag_step(kv):
+        k_cur, v_cur = kv
+        return attention_with_lse(q, k_cur, v_cur, causal=True,
+                                  scale=scale)
+
+    def full_step(kv):
+        k_cur, v_cur = kv
+        return attention_with_lse(q, k_cur, v_cur, causal=False,
+                                  scale=scale)
+
+    def skip_step(kv):
+        return (jnp.zeros((b, h, s_local, d), q.dtype),
+                jnp.full((b, h, s_local), _NEG_INF, jnp.float32))
+
+    def step(carry, step_idx):
+        out, lse, k_cur, v_cur = carry
+        src = (rank - step_idx) % n
+        if causal:
+            branch = jnp.where(src == rank, 0,
+                               jnp.where(src < rank, 1, 2))
+        else:
+            branch = jnp.ones((), jnp.int32)
+        o_i, lse_i = jax.lax.switch(
+            branch, [diag_step, full_step, skip_step], (k_cur, v_cur))
+        new_lse = jnp.logaddexp(lse, lse_i)
+        w_old = jnp.exp(lse - new_lse)[..., None]
+        w_new = jnp.exp(lse_i - new_lse)[..., None]
+        out = out * w_old + o_i.astype(jnp.float32) * w_new
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (out, new_lse, k_next, v_next), None
+
+    out0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+    lse0 = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
+    (out, _, _, _), _ = jax.lax.scan(
+        step, (out0, lse0, k, v), jnp.arange(n))
+    return out.astype(q.dtype)
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
                    causal: bool = True,
-                   batch_axes=("dp", "fsdp"), heads_axis="tp"):
-    """Sharded entry point: shard_map-wraps :func:`ring_attention_local`.
+                   batch_axes=("dp", "fsdp"), heads_axis="tp",
+                   impl: str = "einsum"):
+    """Sharded entry point: shard_map-wraps the ring body.
 
-    q/k/v: global arrays [B, H, S, D]; S must divide by the sp axis size.
+    q/k/v: global arrays [B, H, S, D]; S must divide by the sp axis
+    size. ``impl='flash'`` uses the fused flash block per step
+    (forward-only); ``'einsum'`` is the autodiff-able training body.
     """
     from .sharding import smap
 
+    body = (ring_flash_attention_local if impl == "flash"
+            else ring_attention_local)
     spec = P(batch_axes, heads_axis, axis_name, None)
     fn = smap(
-        functools.partial(ring_attention_local, axis_name=axis_name,
-                          causal=causal),
+        functools.partial(body, axis_name=axis_name, causal=causal),
         mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )
     return fn(q, k, v)

@@ -1,0 +1,57 @@
+"""Ring-flash parity: the flash-block ring body must match full
+attention and the einsum ring body (VERDICT r4 item 5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.attention import mha_reference, mha_reference_with_lse
+from ray_tpu.parallel.mesh import MeshSpec
+from ray_tpu.parallel.ring import ring_attention
+
+
+def _qkv(b=2, h=4, s=256, d=32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (b, h, s, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_matches_full_reference(causal):
+    q, k, v = _qkv()
+    mesh = MeshSpec(sp=4).build(jax.devices()[:4])
+    out = ring_attention(q, k, v, mesh, causal=causal, batch_axes=(),
+                         heads_axis=None, impl="flash")
+    ref = mha_reference(q, k, v, causal=causal)
+    err = float(jnp.max(jnp.abs(out - ref)))
+    assert err < 1e-2, f"ring-flash vs reference max err {err}"
+    assert err < 1e-4  # fp32 blocks should be much tighter than 1e-2
+
+
+def test_ring_flash_matches_einsum_ring():
+    q, k, v = _qkv(seed=3)
+    mesh = MeshSpec(sp=4).build(jax.devices()[:4])
+    flash = ring_attention(q, k, v, mesh, causal=True, batch_axes=(),
+                           heads_axis=None, impl="flash")
+    einsum = ring_attention(q, k, v, mesh, causal=True, batch_axes=(),
+                            heads_axis=None, impl="einsum")
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(einsum),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_reference_with_lse_consistent():
+    q, k, v = _qkv(b=1, h=2, s=64, d=16, seed=7)
+    o, lse = mha_reference_with_lse(q, k, v, causal=True)
+    o2 = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o2), atol=1e-5)
+    # lse really is logsumexp of the scaled causal logits
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = np.einsum("bhqd,bhkd->bhqk", np.asarray(q),
+                       np.asarray(k)).astype(np.float64) * scale
+    s = q.shape[2]
+    mask = np.arange(s)[:, None] >= np.arange(s)[None, :]
+    logits = np.where(mask, logits, -1e30)
+    want = np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1)
+                  ) + logits.max(-1)
+    np.testing.assert_allclose(np.asarray(lse), want, atol=1e-3)
