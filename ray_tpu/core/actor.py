@@ -241,12 +241,17 @@ class ActorClass:
         )
         # Publish the handle for named lookup (get_actor); reference:
         # named-actor table in GCS + serialized handle in internal KV.
-        head = _head_runtime(rt)
-        if head is not None:
-            head.gcs.kv_put(
-                b"actor_handle:" + actor_id.binary(),
-                serialization.dumps(handle), "actors",
-            )
+        # From a WORKER process the publication rides an RPC to the head
+        # — without it, named actors created inside tasks/actors were
+        # registered in the name table but never resolvable.
+        if opts["name"]:
+            blob = serialization.dumps(handle)
+            head = _head_runtime(rt)
+            if head is not None:
+                head.gcs.kv_put(
+                    b"actor_handle:" + actor_id.binary(), blob, "actors")
+            elif hasattr(rt, "_rpc"):
+                rt._rpc("put_named_handle", actor_id.binary(), blob)
         return handle
 
 

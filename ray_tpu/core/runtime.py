@@ -1333,7 +1333,8 @@ class Runtime:
             # executor; fetches don't depend on each other, so the cap
             # cannot deadlock.
             self._fetch_pool().submit(self._handle_worker_rpc, worker, msg)
-        elif kind in ("put", "submit", "kill_actor", "cancel", "get_actor"):
+        elif kind in ("put", "submit", "kill_actor", "cancel", "get_actor",
+                      "put_named_handle"):
             # Quick, non-blocking RPCs run inline on this worker's reader
             # thread (ordering preserved, no thread churn). Blocking
             # get/wait are fully ASYNC above — callbacks on object
@@ -1577,6 +1578,11 @@ class Runtime:
             elif kind == "cancel":
                 _, _, oid_bin, force = msg
                 self.cancel(ObjectRef(ObjectID(oid_bin), _register=False), force)
+                worker.send(("reply", req_id, True, None))
+            elif kind == "put_named_handle":
+                _, _, actor_bin, blob = msg
+                self.gcs.kv_put(b"actor_handle:" + actor_bin, blob,
+                                "actors")
                 worker.send(("reply", req_id, True, None))
             elif kind == "get_actor":
                 _, _, name, namespace = msg

@@ -82,7 +82,8 @@ def ring_attention_local(q, k, v, axis_name: str = "sp",
 
 def ring_flash_attention_local(q, k, v, axis_name: str = "sp",
                                causal: bool = True,
-                               scale: Optional[float] = None):
+                               scale: Optional[float] = None,
+                               block_impl: str = "auto"):
     """Ring attention whose per-step block compute is the FLASH kernel
     (``ops.attention``): each step runs one flash forward of the local Q
     shard against the K/V shard currently held, and partial outputs
@@ -110,12 +111,12 @@ def ring_flash_attention_local(q, k, v, axis_name: str = "sp",
     def diag_step(kv):
         k_cur, v_cur = kv
         return attention_with_lse(q, k_cur, v_cur, causal=True,
-                                  scale=scale)
+                                  scale=scale, impl=block_impl)
 
     def full_step(kv):
         k_cur, v_cur = kv
         return attention_with_lse(q, k_cur, v_cur, causal=False,
-                                  scale=scale)
+                                  scale=scale, impl=block_impl)
 
     def skip_step(kv):
         return (jnp.zeros((b, h, s_local, d), q.dtype),

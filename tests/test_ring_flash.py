@@ -40,6 +40,30 @@ def test_ring_flash_matches_einsum_ring():
                                atol=1e-4, rtol=1e-4)
 
 
+def test_ring_flash_pallas_kernel_interpret():
+    """Exercise the REAL pallas lse-producing kernel (interpret mode on
+    CPU) inside the ring merge — impl='auto' would silently fall back
+    to the reference path off-TPU and leave the kernel's lse contract
+    uncovered."""
+    import functools
+
+    from ray_tpu.parallel.ring import ring_flash_attention_local
+    from ray_tpu.parallel.sharding import smap
+    from jax.sharding import PartitionSpec as P
+
+    q, k, v = _qkv(b=1, h=2, s=128, d=32, seed=5)
+    mesh = MeshSpec(sp=2).build(jax.devices()[:2])
+    spec = P(None, None, "sp", None)
+    fn = smap(
+        functools.partial(ring_flash_attention_local, axis_name="sp",
+                          causal=True, block_impl="flash"),
+        mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    out = fn(q, k, v)
+    ref = mha_reference(q, k, v, causal=True)
+    err = float(jnp.max(jnp.abs(out - ref)))
+    assert err < 1e-2, f"pallas-block ring vs reference max err {err}"
+
+
 def test_reference_with_lse_consistent():
     q, k, v = _qkv(b=1, h=2, s=64, d=16, seed=7)
     o, lse = mha_reference_with_lse(q, k, v, causal=True)
