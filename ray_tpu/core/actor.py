@@ -250,7 +250,10 @@ class ActorClass:
             if head is not None:
                 head.gcs.kv_put(
                     b"actor_handle:" + actor_id.binary(), blob, "actors")
-            elif hasattr(rt, "_rpc"):
+            else:
+                # Worker runtimes publish via RPC; anything else would
+                # leave the named actor permanently unresolvable, so
+                # fail loudly rather than register a ghost name.
                 rt._rpc("put_named_handle", actor_id.binary(), blob)
         return handle
 
@@ -273,6 +276,11 @@ def get_actor(name: str, namespace: str = "default") -> ActorHandle:
             raise ValueError(f"Failed to look up actor {name!r}")
         blob = head.gcs.kv_get(b"actor_handle:" + info.actor_id.binary(),
                                "actors")
+        if blob is None:
+            # Name registered but handle not yet published (the two
+            # arrive as separate messages from a worker creator) —
+            # retryable, same error type as not-found.
+            raise ValueError(f"Failed to look up actor {name!r}")
         return serialization.loads(blob)
     # Worker process: RPC to the head.
     blob = rt._rpc("get_actor", name, namespace)

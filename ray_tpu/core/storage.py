@@ -28,8 +28,10 @@ class StorageClient:
     """Scoped KV-ish file workspace (reference: storage.KVClient)."""
 
     def __init__(self, root: str):
+        # No makedirs here: constructing a client must not mutate the
+        # store (read-only probes like Tuner.can_restore build clients
+        # for paths that may not exist). put() creates dirs on write.
         self.root = root
-        os.makedirs(root, exist_ok=True)
 
     def _path(self, key: str) -> str:
         root = os.path.normpath(self.root)
@@ -105,6 +107,19 @@ def register_scheme(scheme: str,
     _schemes[scheme] = factory
 
 
+def client_for_uri(uri: str, prefix: str = "") -> StorageClient:
+    """Client for an EXPLICIT storage URI (scheme-registry dispatch),
+    independent of the cluster-wide configured root — used by components
+    that take their own destination, e.g. the Tune syncer."""
+    scheme, sep, rest = uri.partition("://")
+    if sep and scheme != "file":
+        if scheme in _schemes:
+            return _schemes[scheme](uri, prefix)
+        raise ValueError(f"unsupported storage scheme {scheme!r}")
+    root = rest if sep else uri
+    return StorageClient(os.path.join(root, prefix) if prefix else root)
+
+
 def get_client(prefix: str = "") -> StorageClient:
     """Scoped client under the configured storage root.
 
@@ -115,10 +130,4 @@ def get_client(prefix: str = "") -> StorageClient:
     if uri is None:
         raise RuntimeError(
             "storage is not configured; pass storage=... to rt.init()")
-    scheme, sep, rest = uri.partition("://")
-    if sep and scheme != "file":
-        if scheme in _schemes:
-            return _schemes[scheme](uri, prefix)
-        raise ValueError(f"unsupported storage scheme {scheme!r}")
-    root = rest if sep else uri
-    return StorageClient(os.path.join(root, prefix) if prefix else root)
+    return client_for_uri(uri, prefix)

@@ -314,19 +314,29 @@ class _P2PMailbox:
         import time as _t
 
         now = _t.monotonic()
-        # lazy sweep: barrier entries from long-dead cohorts (bounded
-        # growth; reusing a LIVE group name still requires destroy())
-        for k in [k for k, (_, ts) in self._barriers.items()
-                  if now - ts > 600.0]:
+        # lazy sweep of RELEASED entries only (count reached world):
+        # an incomplete entry may still have live waiters with long
+        # timeouts — deleting it would reset the count under them.
+        # Incomplete stale entries are cleared by destroy(). world is
+        # not stored per-entry, so released-ness rides a sentinel count.
+        for k in [k for k, (c, ts) in self._barriers.items()
+                  if c < 0 and now - ts > 600.0]:
             del self._barriers[k]
         k = (group, epoch)
         count, _ = self._barriers.get(k, (0, now))
-        self._barriers[k] = (count + 1, now)
+        if count >= 0:  # negative = already released (late arrival ok)
+            count += 1
+            self._barriers[k] = (count, now)
         deadline = now + timeout
-        while self._barriers.get(k, (0, 0))[0] < world:
+        while True:
+            c, _ = self._barriers.get(k, (0, 0))
+            if c < 0 or c >= world:
+                break
             if _t.monotonic() > deadline:
                 raise TimeoutError(f"barrier {k} timed out")
             await asyncio.sleep(0.002)
+        # mark released so the sweep may reclaim it later
+        self._barriers[k] = (-1, _t.monotonic())
         return True
 
     async def reset_group(self, group: str):

@@ -148,7 +148,8 @@ class TrialRunner:
                  resources_per_trial: Optional[Dict[str, float]] = None,
                  poll_interval: float = 0.05,
                  experiment_path: Optional[str] = None,
-                 checkpoint_period: float = 1.0):
+                 checkpoint_period: float = 1.0,
+                 syncer=None):
         self.trainable = trainable
         self.searcher = searcher
         self.scheduler = scheduler or FIFOScheduler()
@@ -166,6 +167,10 @@ class TrialRunner:
         self._dirty = False
         self._last_save = 0.0
         self._actor_cls = remote(_TrialActor)
+        # Remote mirror (reference: tune/syncer.py): every experiment-
+        # state write is followed by an upload, so the sweep survives
+        # losing this host's filesystem entirely.
+        self.syncer = syncer
 
     # -- experiment-level checkpointing --------------------------------------
     # Reference: trial_runner.py:682 ``checkpoint`` — the runner persists
@@ -178,6 +183,17 @@ class TrialRunner:
         import cloudpickle
 
         os.makedirs(self.experiment_path, exist_ok=True)
+        if self.syncer is not None:
+            # Dir-backed trial checkpoints reference THIS host's paths;
+            # materialize them so the pickle is portable to a fresh
+            # workdir after sync_down.
+            for t in self.trials:
+                ckpt = t.checkpoint
+                if ckpt is not None and getattr(ckpt, "_data", None) is None:
+                    try:
+                        t.checkpoint = Checkpoint.from_dict(ckpt.to_dict())
+                    except Exception:  # noqa: BLE001 — keep original
+                        pass
         # Live actor handles are per-process; strip them for the dump and
         # put them back (single-threaded runner loop — no races). One
         # blob keeps trial references shared by scheduler rungs / PBT
@@ -205,6 +221,8 @@ class TrialRunner:
         with open(tmp, "wb") as f:
             f.write(blob)
         os.replace(tmp, os.path.join(self.experiment_path, self.STATE_FILE))
+        if self.syncer is not None:
+            self.syncer.sync_up(self.experiment_path)
         self._dirty = False
         self._last_save = time.monotonic()
 
@@ -403,14 +421,45 @@ class Tuner:
         self.resources_per_trial = resources_per_trial
         self._restored_state: Optional[Dict] = None
         self._restored_path: Optional[str] = None
+        self._restored_syncer = None
+        self._staging_dir: Optional[str] = None
+
+    def _experiment_name(self) -> str:
+        return self.run_config.name or "tune_experiment"
 
     def _experiment_path(self) -> Optional[str]:
         if self._restored_path:
             return self._restored_path
-        if self.run_config.storage_path is None:
+        sp = self.run_config.storage_path
+        if sp is None:
             return None
-        return os.path.join(self.run_config.storage_path,
-                            self.run_config.name or "tune_experiment")
+        from .syncer import is_uri
+
+        if is_uri(sp):
+            # Remote destination: the experiment runs in a local staging
+            # dir and the syncer mirrors it to the URI after every
+            # state write (reference: tune/syncer.py upload_dir). The
+            # staging dir is uniqued per Tuner instance — a fixed
+            # name-keyed path would let concurrent same-named sweeps
+            # cross-contaminate each other's remote mirrors.
+            if self._staging_dir is None:
+                import tempfile
+
+                self._staging_dir = os.path.join(
+                    tempfile.gettempdir(), "rt_tune_staging",
+                    f"{self._experiment_name()}-{uuid.uuid4().hex[:8]}")
+            return self._staging_dir
+        return os.path.join(sp, self._experiment_name())
+
+    def _syncer(self):
+        from .syncer import Syncer, is_uri
+
+        if self._restored_syncer is not None:
+            return self._restored_syncer
+        sp = self.run_config.storage_path
+        if not is_uri(sp):
+            return None
+        return Syncer(sp.rstrip("/") + "/" + self._experiment_name())
 
     @classmethod
     def restore(cls, path: str,
@@ -421,7 +470,27 @@ class Tuner:
         and searcher/scheduler state (consumed samples, ASHA rungs, PBT
         history) carries over. Reference: ``tune/tuner.py:159``
         ``Tuner.restore`` + experiment checkpointing
-        (``tune/execution/trial_runner.py:682``)."""
+        (``tune/execution/trial_runner.py:682``).
+
+        ``path`` may be a storage URI (the syncer's upload destination):
+        the experiment is synced down into a FRESH staging dir first, so
+        restore works with the original local workdir gone entirely."""
+        from .syncer import Syncer, is_uri
+
+        syncer = None
+        if is_uri(path):
+            import tempfile
+            import uuid as _uuid
+
+            syncer = Syncer(path)
+            staging = os.path.join(
+                tempfile.gettempdir(), "rt_tune_staging",
+                f"restore-{_uuid.uuid4().hex[:8]}")
+            os.makedirs(staging, exist_ok=True)
+            if syncer.sync_down(staging) == 0:
+                raise FileNotFoundError(
+                    f"no experiment state found at {path!r}")
+            path = staging
         state = TrialRunner.load_state(path)
         tuner = cls(
             trainable or state["trainable"],
@@ -435,10 +504,18 @@ class Tuner:
         )
         tuner._restored_state = state
         tuner._restored_path = path
+        tuner._restored_syncer = syncer
         return tuner
 
     @staticmethod
     def can_restore(path: str) -> bool:
+        from .syncer import Syncer, is_uri
+
+        if is_uri(path):
+            try:
+                return Syncer(path).client.exists(TrialRunner.STATE_FILE)
+            except Exception:  # noqa: BLE001 — unknown scheme etc.
+                return False
         return os.path.exists(os.path.join(path, TrialRunner.STATE_FILE))
 
     def fit(self) -> ResultGrid:
@@ -456,6 +533,7 @@ class Tuner:
             stop=self.run_config.stop,
             resources_per_trial=self.resources_per_trial,
             experiment_path=self._experiment_path(),
+            syncer=self._syncer(),
         )
         if self._restored_state is not None:
             runner.restore_from(self._restored_state)

@@ -1,0 +1,77 @@
+"""Tune Syncer: experiment state mirrors to a storage URI and restores
+onto a fresh workdir with the ORIGINAL local staging dir deleted
+(VERDICT r4 item 8; reference tune/syncer.py:184,209,231)."""
+
+import os
+import shutil
+
+import pytest
+
+from ray_tpu.core.storage import client_for_uri
+from ray_tpu.tune.syncer import Syncer, is_uri
+
+
+def test_syncer_roundtrip(tmp_path):
+    local = tmp_path / "local"
+    (local / "sub").mkdir(parents=True)
+    (local / "a.txt").write_bytes(b"alpha")
+    (local / "sub" / "b.bin").write_bytes(b"\x00\x01")
+    (local / "junk.tmp").write_bytes(b"skip me")
+    uri = f"file://{tmp_path}/remote/exp"
+    s = Syncer(uri)
+    assert s.sync_up(str(local)) == 2  # .tmp excluded
+    down = tmp_path / "down"
+    assert Syncer(uri).sync_down(str(down)) == 2
+    assert (down / "a.txt").read_bytes() == b"alpha"
+    assert (down / "sub" / "b.bin").read_bytes() == b"\x00\x01"
+    assert not (down / "junk.tmp").exists()
+
+
+def test_is_uri():
+    assert is_uri("file:///x/y")
+    assert is_uri("mock://bucket/k")
+    assert not is_uri("/plain/path")
+    assert not is_uri(None)
+
+
+def test_tuner_syncs_and_restores_from_uri(tmp_path, rt_shared):
+    """End-to-end: sweep uploads to a URI; the local staging dir is
+    DELETED; Tuner.restore(uri) resumes and finishes the budget."""
+    from ray_tpu import tune
+    from ray_tpu.train.config import RunConfig
+    from ray_tpu.tune import TuneConfig, Tuner
+
+    uri_root = f"file://{tmp_path}/bucket"
+
+    def trainable(config):
+        for i in range(3):
+            tune.report({"score": config["x"] * (i + 1)})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 3, 4])},
+        tune_config=TuneConfig(max_concurrent_trials=2),
+        run_config=RunConfig(name="sync-exp", storage_path=uri_root),
+    )
+    grid = tuner.fit()
+    assert len(grid.trials) == 4
+    assert all(t.status == "TERMINATED" for t in grid.trials)
+
+    # the remote mirror holds the experiment state
+    exp_uri = uri_root + "/sync-exp"
+    assert Tuner.can_restore(exp_uri)
+    client = client_for_uri(exp_uri)
+    assert client.exists("experiment_state.pkl")
+
+    # destroy the local staging dir entirely (uniqued per Tuner)
+    staging = tuner._experiment_path()
+    assert "rt_tune_staging" in staging and os.path.isdir(staging)
+    shutil.rmtree(staging)
+
+    restored = Tuner.restore(exp_uri)
+    grid2 = restored.fit()
+    assert len(grid2.trials) == 4
+    # completed trials kept their results without retraining
+    best = grid2.get_best_result(metric="score", mode="max")
+    assert best.last_result["score"] == 12  # x=4, 3 reports
+    assert not Tuner.can_restore(uri_root + "/absent")
