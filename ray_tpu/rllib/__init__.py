@@ -19,6 +19,8 @@ from .env import (
 from .impala import Impala, ImpalaConfig, vtrace
 from .multi_agent import MultiAgentEnv, make_multi_agent, sample_multi_agent
 from .offline import (
+    DirectMethod,
+    DoublyRobust,
     ImportanceSampling,
     JsonReader,
     JsonWriter,
@@ -35,6 +37,7 @@ from .replay_buffers import (
     ReplayBuffer,
     ReservoirReplayBuffer,
 )
+from .apex import ApexConfig, ApexDQN
 from .marwil import BC, BCConfig, MARWIL, MARWILConfig
 from .rollout_worker import RolloutWorker
 from .sac import SAC, SACConfig
@@ -47,11 +50,12 @@ __all__ = [
     "MultiAgentEnv",
     "make_multi_agent",
     "sample_multi_agent",
-    "ImportanceSampling",
+    "DirectMethod", "DoublyRobust", "ImportanceSampling",
     "JsonReader",
     "JsonWriter",
     "WeightedImportanceSampling",
-    "Algorithm", "AlgorithmConfig", "AtariSim", "DQN", "DQNConfig",
+    "Algorithm", "AlgorithmConfig", "ApexConfig", "ApexDQN",
+    "AtariSim", "DQN", "DQNConfig",
     "FastCartPole", "FastPendulum", "GymVectorEnv", "Impala",
     "BC", "BCConfig", "MARWIL", "MARWILConfig",
     "ImpalaConfig", "JAX_ENVS", "MODEL_DEFAULTS", "Network", "SAC",

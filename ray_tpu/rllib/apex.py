@@ -1,0 +1,235 @@
+"""Ape-X DQN: distributed prioritized experience replay.
+
+Reference analog: ``rllib/algorithms/apex_dqn/apex_dqn.py`` (Horgan et
+al. 2018) — the three Ape-X separations, each mapped onto this
+framework's actor substrate:
+
+- ROLLOUT workers compute INITIAL priorities (|td| under their current
+  weights) locally and ship (batch, priorities) to the replay tier, so
+  the learner never touches raw transitions it won't sample;
+- the REPLAY tier is a set of sharded ``PrioritizedReplayBuffer``
+  actors — adds, prioritized samples, and priority updates all run as
+  actor RPCs over the object plane (this algorithm deliberately
+  stresses the core runtime, not just another loss);
+- the LEARNER keeps one in-flight sample per rollout worker (the
+  IMPALA-style ``wait`` pump), trains from round-robin shard samples,
+  pushes priority corrections back to the owning shard, and broadcasts
+  weights on a period instead of every update.
+
+Per-worker exploration follows the Ape-X schedule
+``eps_i = base ** (1 + i/(N-1) * alpha)`` — a fleet of differently
+greedy explorers instead of one annealed epsilon.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import get, kill, remote, wait
+from .dqn import DQN, DQNConfig, DQNRolloutWorker, q_values
+from .replay_buffers import PrioritizedReplayBuffer
+from .sample_batch import ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch
+
+
+class ApexRolloutWorker(DQNRolloutWorker):
+    """DQN rollout worker that ships initial priorities with its data."""
+
+    def sample_with_priorities(self, rollout_length: int, gamma: float):
+        batch = self.sample(rollout_length)
+        params = self.policy.params
+        q = np.asarray(q_values(params, jnp.asarray(batch[OBS])))
+        q_taken = q[np.arange(batch.count),
+                    np.asarray(batch[ACTIONS]).astype(np.int64)]
+        next_q_online = np.asarray(
+            q_values(params, jnp.asarray(batch[NEXT_OBS])))
+        # Workers hold no target net; the online net both picks and
+        # values for the INITIAL priority — it only seeds the sampling
+        # distribution, the learner's updates use the real target net.
+        next_a = np.argmax(next_q_online, axis=-1)
+        next_q = next_q_online[np.arange(batch.count), next_a]
+        not_done = 1.0 - np.asarray(batch[DONES], np.float32)
+        target = np.asarray(batch[REWARDS]) + gamma * not_done * next_q
+        prios = np.abs(q_taken - target).astype(np.float32)
+        return dict(batch), prios
+
+
+class ReplayShard:
+    """Actor hosting one prioritized replay shard (reference: the
+    ``ReplayActor`` of apex_dqn.py)."""
+
+    def __init__(self, capacity: int, alpha: float, seed: int):
+        self.buffer = PrioritizedReplayBuffer(capacity, alpha=alpha,
+                                              seed=seed)
+        self.adds = 0
+        self.samples = 0
+
+    def add(self, batch: Dict, priorities) -> int:
+        self.buffer.add(SampleBatch(batch), priorities)
+        self.adds += 1
+        return len(self.buffer)
+
+    def sample(self, num_items: int, beta: float):
+        if len(self.buffer) < num_items:
+            return None
+        self.samples += 1
+        return dict(self.buffer.sample(num_items, beta=beta))
+
+    def update_priorities(self, idx, priorities) -> bool:
+        self.buffer.update_priorities(np.asarray(idx),
+                                      np.asarray(priorities))
+        return True
+
+    def stats(self) -> Dict:
+        return {"size": len(self.buffer), "adds": self.adds,
+                "samples": self.samples}
+
+
+class ApexConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self._algo_class = ApexDQN
+        self.num_rollout_workers = 2
+        self.num_replay_shards = 2
+        self.worker_epsilon_base = 0.4
+        self.worker_epsilon_alpha = 7.0
+        self.weight_sync_period = 16  # learner updates between broadcasts
+        self.sample_wait_timeout = 10.0
+
+    def training(self, **kwargs) -> "ApexConfig":
+        for k in ("num_replay_shards", "worker_epsilon_base",
+                  "worker_epsilon_alpha", "weight_sync_period"):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        super().training(**kwargs)
+        return self
+
+
+class ApexDQN(DQN):
+    """Distributed replay on the actor substrate; learner math is DQN's."""
+
+    _worker_cls = ApexRolloutWorker
+
+    def setup(self, config: ApexConfig) -> None:
+        super().setup(config)
+        self.buffer = None  # replaced by the sharded replay tier
+        shard_cls = remote(ReplayShard)
+        per_shard = max(1, config.buffer_capacity
+                        // max(config.num_replay_shards, 1))
+        self.shards = [
+            shard_cls.options(num_cpus=0).remote(
+                per_shard, config.prioritized_alpha, config.seed + i)
+            for i in range(config.num_replay_shards)
+        ]
+        # which shard a learner batch came from, keyed by shard index
+        self._add_rr = 0
+        self._sample_rr = 0
+        self._replay_size = 0
+        self._in_flight: Dict = {}
+        # Ape-X per-worker epsilon ladder (constant, not annealed).
+        n = max(len(self.workers.remote_workers), 1)
+        base, alpha = (config.worker_epsilon_base,
+                       config.worker_epsilon_alpha)
+        self._epsilons = [
+            float(base ** (1.0 + (i / max(n - 1, 1)) * alpha))
+            for i in range(n)
+        ]
+        for i, w in enumerate(self.workers.remote_workers):
+            eps = self._epsilons[i]
+            get(w.apply.remote(
+                lambda wk, e=eps: wk.set_epsilon(e)), timeout=60)
+        self.workers.local_worker.set_epsilon(self._epsilons[0])
+
+    def _push_to_shard(self, batch: Dict, prios) -> None:
+        shard = self.shards[self._add_rr % len(self.shards)]
+        self._add_rr += 1
+        # fire-and-forget: the learner never blocks on replay ingestion
+        shard.add.remote(batch, prios)
+
+    def _pump_workers(self) -> int:
+        """Keep one in-flight sample per remote worker; drain finished
+        ones into the replay tier. Returns new env-steps observed."""
+        cfg = self.config
+        new_steps = 0
+        for w in self.workers.remote_workers:
+            if w not in self._in_flight.values():
+                ref = w.sample_with_priorities.remote(
+                    cfg.rollout_fragment_length, cfg.gamma)
+                self._in_flight[ref] = w
+        if self._in_flight:
+            ready, _ = wait(list(self._in_flight),
+                            num_returns=1,
+                            timeout=cfg.sample_wait_timeout)
+            for ref in ready:
+                self._in_flight.pop(ref)
+                batch, prios = get(ref)
+                new_steps += len(prios)
+                self._push_to_shard(batch, prios)
+        return new_steps
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        if self.workers.remote_workers:
+            new_steps = self._pump_workers()
+        else:  # synchronous fallback (tests / single-core debug)
+            batch, prios = self.workers.local_worker \
+                .sample_with_priorities(cfg.rollout_fragment_length,
+                                        cfg.gamma)
+            self._push_to_shard(batch, prios)
+            new_steps = len(prios)
+        self._timesteps_total += new_steps
+
+        losses = []
+        # Gate on learning_starts like DQN: correlated warm-up data must
+        # not drive the first updates. _replay_size is last tick's shard
+        # total (refreshing it costs one RPC fan-out per step anyway).
+        updates_allowed = (cfg.num_updates_per_iter
+                           if self._replay_size >= cfg.learning_starts
+                           else 0)
+        for _ in range(updates_allowed):
+            shard_i = self._sample_rr % len(self.shards)
+            self._sample_rr += 1
+            shard = self.shards[shard_i]
+            sampled = get(shard.sample.remote(
+                cfg.train_batch_size, cfg.prioritized_beta), timeout=60)
+            if sampled is None:
+                continue  # shard still warming up
+            jbatch = {k: jnp.asarray(v) for k, v in sampled.items()
+                      if k != "batch_indexes"}
+            self.params, self.opt_state, loss, td = self._update(
+                self.params, self.target_params, self.opt_state, jbatch)
+            shard.update_priorities.remote(
+                sampled["batch_indexes"], np.asarray(td))
+            self._num_updates += 1
+            if self._num_updates % cfg.target_network_update_freq == 0:
+                self.target_params = jax.tree.map(jnp.copy, self.params)
+            if self._num_updates % cfg.weight_sync_period == 0:
+                weights = jax.tree.map(np.asarray, self.params)
+                self.workers.local_worker.set_weights(weights)
+                self.workers.sync_weights(weights)
+            losses.append(float(loss))
+
+        shard_stats = get([s.stats.remote() for s in self.shards],
+                          timeout=60)
+        self._replay_size = int(sum(s["size"] for s in shard_stats))
+        return {
+            "timesteps_this_iter": new_steps,
+            "num_learner_updates": self._num_updates,
+            "replay_shards": shard_stats,
+            "replay_buffer_size": int(sum(s["size"]
+                                          for s in shard_stats)),
+            "loss": float(np.mean(losses)) if losses else None,
+        }
+
+    def stop(self) -> None:
+        for ref in list(self._in_flight):
+            self._in_flight.pop(ref)
+        for s in getattr(self, "shards", []):
+            try:
+                kill(s)
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+        super().stop()

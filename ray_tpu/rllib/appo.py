@@ -14,25 +14,22 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
-from .impala import Impala, ImpalaConfig, vtrace
+import functools
+
+from .impala import Impala, ImpalaConfig, forward_feedforward, vtrace
 from .policy import forward_mlp
-from .sample_batch import ACTIONS, DONES, LOGPS, OBS, REWARDS
+from .sample_batch import ACTIONS, DONES, LOGPS, REWARDS
 
 
 def appo_loss(params, batch, gamma, vf_coeff, ent_coeff, clip_param,
-              apply_fn=forward_mlp):
+              apply_fn=forward_mlp, forward=None):
     """IMPALA loss with the PPO clipped surrogate on V-trace advantages."""
-    obs = batch[OBS]
-    t_len, n = obs.shape[:2]
-    flat_obs = obs.reshape((t_len * n,) + obs.shape[2:])
-    logits, values = apply_fn(params, flat_obs)
-    logits = logits.reshape(t_len, n, -1)
-    values = values.reshape(t_len, n)
-    logp_all = jax.nn.log_softmax(logits)
+    if forward is None:
+        forward = functools.partial(forward_feedforward, apply_fn=apply_fn)
+    logp_all, values, bootstrap = forward(params, batch)
     actions = batch[ACTIONS].astype(jnp.int32)
     target_logp = jnp.take_along_axis(
         logp_all, actions[..., None], axis=-1)[..., 0]
-    _, bootstrap = apply_fn(params, batch["final_obs"])
 
     vs, pg_adv = vtrace(batch[LOGPS], target_logp, batch[REWARDS],
                         batch[DONES], values, bootstrap, gamma)
@@ -68,21 +65,17 @@ class APPO(Impala):
         import optax
 
         super().setup(config)
-        if self.workers.local_worker.policy.net.is_recurrent:
-            raise NotImplementedError(
-                "APPO does not support recurrent models "
-                "(model={'use_lstm': True}); use PPO")
         gamma = config.gamma
         vf_coeff, ent_coeff = config.vf_coeff, config.entropy_coeff
         clip_param = config.clip_param
-        apply_fn = self.workers.local_worker.policy.net.apply
+        forward = self._make_forward()  # recurrent-aware (Impala)
 
         @jax.jit
         def update(params, opt_state, batch):
             (loss, metrics), grads = jax.value_and_grad(
                 appo_loss, has_aux=True)(
                     params, batch, gamma, vf_coeff, ent_coeff,
-                    clip_param, apply_fn)
+                    clip_param, forward=forward)
             updates, opt_state = self.optimizer.update(grads, opt_state,
                                                        params)
             params = optax.apply_updates(params, updates)

@@ -194,6 +194,57 @@ class FastPendulum(VectorEnv):
         return (self._obs(), (-costs).astype(np.float32), done, {})
 
 
+class RepeatPrevObs(VectorEnv):
+    """Memory probe env: the reward at step t is 1 iff the action
+    equals the SIGNAL SHOWN AT t-1. A feedforward policy sees only the
+    current signal — independent of the correct answer — so its best
+    possible mean reward is chance (1/num_signals); any policy with one
+    step of memory can score ~1 per step. Used to prove recurrent
+    V-trace actually trains the recurrent pathway."""
+
+    NUM_SIGNALS = 3
+    MAX_STEPS = 32
+
+    def __init__(self, num_envs: int = 1, seed: int = 0):
+        self.num_envs = num_envs
+        self.observation_space_shape = (self.NUM_SIGNALS,)
+        self.num_actions = self.NUM_SIGNALS
+        self._rng = np.random.default_rng(seed)
+        self._signal = np.zeros(num_envs, np.int64)
+        self._prev = np.zeros(num_envs, np.int64)
+        self._steps = np.zeros(num_envs, np.int32)
+
+    def _obs(self) -> np.ndarray:
+        out = np.zeros((self.num_envs, self.NUM_SIGNALS), np.float32)
+        out[np.arange(self.num_envs), self._signal] = 1.0
+        return out
+
+    def _reset_some(self, mask) -> None:
+        n = int(np.sum(mask))
+        if not n:
+            return
+        self._signal[mask] = self._rng.integers(0, self.NUM_SIGNALS, n)
+        self._prev[mask] = 0  # the known start token
+        self._steps[mask] = 0
+
+    def vector_reset(self, seed=None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._reset_some(np.ones(self.num_envs, bool))
+        return self._obs()
+
+    def vector_step(self, actions):
+        actions = np.asarray(actions).reshape(self.num_envs)
+        rewards = (actions == self._prev).astype(np.float32)
+        self._prev = self._signal.copy()
+        self._signal = self._rng.integers(0, self.NUM_SIGNALS,
+                                          self.num_envs)
+        self._steps += 1
+        done = self._steps >= self.MAX_STEPS
+        self._reset_some(done)
+        return self._obs(), rewards, done, {}
+
+
 class AtariSim(VectorEnv):
     """Synthetic Atari-SHAPED env: 84x84x4 uint8 frame-stack observations,
     6 actions, pong-like ball/paddle dynamics rendered with vectorized
@@ -286,4 +337,6 @@ def make_env(env: Any, num_envs: int, seed: int = 0) -> VectorEnv:
         return FastPendulum(num_envs, seed)
     if env == "AtariSim":
         return AtariSim(num_envs, seed)
+    if env == "RepeatPrevObs":
+        return RepeatPrevObs(num_envs, seed)
     return GymVectorEnv(env, num_envs)

@@ -28,6 +28,7 @@ from .sample_batch import (
     LOGPS,
     OBS,
     REWARDS,
+    STATE_IN,
     SampleBatch,
 )
 
@@ -62,20 +63,55 @@ def vtrace(behavior_logp, target_logp, rewards, dones, values, bootstrap,
     return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
 
 
-def impala_loss(params, batch, gamma, vf_coeff, ent_coeff,
-                apply_fn=forward_mlp):
-    """batch: time-major [T, B] columns + final_obs [B, obs]."""
+def forward_feedforward(params, batch, apply_fn):
+    """Feedforward target-policy forward over a time-major [T, B] batch:
+    returns (logp_all [T,B,A], values [T,B], bootstrap [B])."""
     obs = batch[OBS]
     t_len, n = obs.shape[:2]
     flat_obs = obs.reshape((t_len * n,) + obs.shape[2:])
     logits, values = apply_fn(params, flat_obs)
     logits = logits.reshape(t_len, n, -1)
     values = values.reshape(t_len, n)
-    logp_all = jax.nn.log_softmax(logits)
+    _, bootstrap = apply_fn(params, batch["final_obs"])
+    return jax.nn.log_softmax(logits), values, bootstrap
+
+
+def forward_recurrent(params, batch, apply_state):
+    """Recurrent target-policy forward (recurrent V-trace, reference:
+    the LSTM-first IMPALA of ``rllib/algorithms/impala/``): scan the
+    cell over T from STATE_IN — the BEHAVIOR policy's state at fragment
+    start, shipped by the rollout worker — zeroing state at episode
+    boundaries; the bootstrap value runs final_obs through the
+    post-rollout state, exactly the state the behavior policy would
+    carry into step T."""
+    obs, dones = batch[OBS], batch[DONES]
+
+    def step(state, xs):
+        obs_t, done_t = xs
+        logits, values, new_state = apply_state(params, obs_t, state)
+        mask = (1.0 - done_t.astype(jnp.float32))[:, None]
+        new_state = tuple(s * mask for s in new_state)
+        return new_state, (logits, values)
+
+    state0 = tuple(batch[STATE_IN][i]
+                   for i in range(batch[STATE_IN].shape[0]))
+    final_state, (logits, values) = jax.lax.scan(step, state0,
+                                                 (obs, dones))
+    _, bootstrap, _ = apply_state(params, batch["final_obs"],
+                                  final_state)
+    return jax.nn.log_softmax(logits), values, bootstrap
+
+
+def impala_loss(params, batch, gamma, vf_coeff, ent_coeff,
+                apply_fn=forward_mlp, forward=None):
+    """batch: time-major [T, B] columns + final_obs [B, obs] (+ STATE_IN
+    [S, B, cell] on the recurrent path)."""
+    if forward is None:
+        forward = functools.partial(forward_feedforward, apply_fn=apply_fn)
+    logp_all, values, bootstrap = forward(params, batch)
     actions = batch[ACTIONS].astype(jnp.int32)
     target_logp = jnp.take_along_axis(
         logp_all, actions[..., None], axis=-1)[..., 0]
-    _, bootstrap = apply_fn(params, batch["final_obs"])
 
     vs, pg_adv = vtrace(batch[LOGPS], target_logp, batch[REWARDS],
                         batch[DONES], values, bootstrap, gamma)
@@ -121,10 +157,6 @@ class Impala(Algorithm):
         import optax
 
         super().setup(config)
-        if self.workers.local_worker.policy.net.is_recurrent:
-            raise NotImplementedError(
-                "IMPALA does not support recurrent models "
-                "(model={'use_lstm': True}); use PPO")
         self.params = self.workers.local_worker.policy.params
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(config.grad_clip),
@@ -136,19 +168,29 @@ class Impala(Algorithm):
 
         gamma = config.gamma
         vf_coeff, ent_coeff = config.vf_coeff, config.entropy_coeff
-        apply_fn = self.workers.local_worker.policy.net.apply
+        forward = self._make_forward()
 
         @jax.jit
         def update(params, opt_state, batch):
             (loss, metrics), grads = jax.value_and_grad(
                 impala_loss, has_aux=True)(params, batch, gamma,
-                                           vf_coeff, ent_coeff, apply_fn)
+                                           vf_coeff, ent_coeff,
+                                           forward=forward)
             updates, opt_state = self.optimizer.update(grads, opt_state,
                                                        params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss, metrics
 
         self._update = update
+
+    def _make_forward(self):
+        """Target-policy forward matched to the model: recurrent models
+        get the scanning V-trace path (dropping the r4 guard)."""
+        net = self.workers.local_worker.policy.net
+        if net.is_recurrent:
+            return functools.partial(forward_recurrent,
+                                     apply_state=net.apply_state)
+        return functools.partial(forward_feedforward, apply_fn=net.apply)
 
     def _learn_on(self, batch: SampleBatch) -> Tuple[float, Dict]:
         jbatch = {k: jnp.asarray(v) for k, v in batch.items()

@@ -16,7 +16,8 @@ from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
-from .sample_batch import ACTIONS, DONES, LOGPS, OBS, REWARDS, SampleBatch
+from .sample_batch import (ACTIONS, DONES, LOGPS, NEXT_OBS, OBS, REWARDS,
+                           SampleBatch)
 
 
 class JsonWriter:
@@ -110,10 +111,15 @@ class OffPolicyEstimator:
             yield {k: np.asarray(v)[start:end] for k, v in batch.items()}
             start = end
 
+    def _behavior_return(self, ep) -> float:
+        rewards = np.asarray(ep[REWARDS], np.float64)
+        return float(np.sum(self.gamma ** np.arange(len(rewards))
+                            * rewards))
+
     def _episode_terms(self, ep) -> Dict[str, float]:
         rewards = ep[REWARDS].astype(np.float64)
         discounts = self.gamma ** np.arange(len(rewards))
-        behavior_return = float(np.sum(discounts * rewards))
+        behavior_return = self._behavior_return(ep)
         target_logp = np.asarray(self._logp(ep[OBS], ep[ACTIONS]),
                                  np.float64)
         log_ratio = np.cumsum(target_logp - ep[LOGPS].astype(np.float64))
@@ -174,6 +180,177 @@ class WeightedImportanceSampling(OffPolicyEstimator):
             v_b += e["behavior_return"]
             v_t += float(np.sum(norm * e["discounted_rewards"]))
         n = len(episodes)
+        v_b, v_t = v_b / n, v_t / n
+        return {"v_behavior": v_b, "v_target": v_t,
+                "v_gain": v_t / v_b if v_b else float("nan")}
+
+
+class FittedQModel:
+    """Fitted-Q evaluation (FQE): a small JAX Q-network trained by
+    Bellman backups under the TARGET policy's action distribution —
+    the model component of the direct-method and doubly-robust
+    estimators (reference: ``offline/estimators/fqe_torch_model.py``,
+    re-expressed as a jitted optax loop; discrete actions).
+    """
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden=(32, 32), lr: float = 5e-3, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.num_actions = num_actions
+        key = jax.random.PRNGKey(seed)
+        sizes = (obs_dim, *hidden, num_actions)
+        params = []
+        for i in range(len(sizes) - 1):
+            key, sub = jax.random.split(key)
+            w = jax.random.normal(sub, (sizes[i], sizes[i + 1]),
+                                  jnp.float32)
+            w = w / np.sqrt(sizes[i])
+            params.append({"w": w, "b": jnp.zeros((sizes[i + 1],))})
+        self.params = params
+        self._opt = optax.adam(lr)
+        self._opt_state = self._opt.init(params)
+
+        def q_fn(params, obs):
+            x = obs
+            for layer in params[:-1]:
+                x = jnp.tanh(x @ layer["w"] + layer["b"])
+            last = params[-1]
+            return x @ last["w"] + last["b"]  # [T, A]
+
+        opt = self._opt
+
+        def sgd_step(params, opt_state, obs, act, y):
+            def loss_fn(p):
+                q = q_fn(p, obs)
+                qa = jnp.take_along_axis(q, act[:, None], axis=1)[:, 0]
+                return jnp.mean((qa - y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._q = jax.jit(q_fn)
+        self._sgd = jax.jit(sgd_step)
+
+    def fit(self, obs, actions, rewards, next_obs, dones, next_probs,
+            gamma: float, backups: int = 20, sgd_per_backup: int = 25
+            ) -> float:
+        """Iterate Bellman backups: y = r + gamma*(1-d)*E_{a'~pi}Q(s',a')
+        with Q frozen per backup, then regress. Returns final loss."""
+        import jax.numpy as jnp
+
+        obs = jnp.asarray(obs, jnp.float32)
+        actions = jnp.asarray(actions, jnp.int32)
+        rewards = jnp.asarray(rewards, jnp.float32)
+        next_obs = jnp.asarray(next_obs, jnp.float32)
+        not_done = 1.0 - jnp.asarray(dones, jnp.float32)
+        next_probs = jnp.asarray(next_probs, jnp.float32)
+        loss = float("nan")
+        for _ in range(backups):
+            next_q = self._q(self.params, next_obs)
+            next_v = jnp.sum(next_probs * next_q, axis=1)
+            y = rewards + gamma * not_done * next_v
+            for _ in range(sgd_per_backup):
+                self.params, self._opt_state, loss = self._sgd(
+                    self.params, self._opt_state, obs, actions, y)
+        return float(loss)
+
+    def q_values(self, obs) -> np.ndarray:
+        import jax.numpy as jnp
+
+        return np.asarray(self._q(self.params,
+                                  jnp.asarray(obs, jnp.float32)))
+
+    def v_values(self, obs, probs) -> np.ndarray:
+        return np.sum(np.asarray(probs) * self.q_values(obs), axis=1)
+
+
+class _ModelBasedEstimator(OffPolicyEstimator):
+    """Shared FQE plumbing for DM/DR. ``target_probs_fn(obs) -> [T, A]``
+    gives the target policy's full action distribution (needed both for
+    Bellman backups and for E_{a~pi} Q(s, a))."""
+
+    def __init__(self, target_logp_fn: Callable, target_probs_fn: Callable,
+                 num_actions: int, gamma: float = 0.99,
+                 q_hidden=(32, 32), q_lr: float = 5e-3,
+                 q_backups: int = 20, seed: int = 0):
+        super().__init__(target_logp_fn, gamma)
+        self._probs = target_probs_fn
+        self.num_actions = num_actions
+        self._q_hidden = q_hidden
+        self._q_lr = q_lr
+        self._q_backups = q_backups
+        self._seed = seed
+
+    def _fit_q(self, batch: SampleBatch) -> FittedQModel:
+        obs = np.asarray(batch[OBS], np.float32)
+        next_obs = np.asarray(batch[NEXT_OBS], np.float32)
+        model = FittedQModel(obs.shape[-1], self.num_actions,
+                             hidden=self._q_hidden, lr=self._q_lr,
+                             seed=self._seed)
+        model.fit(obs, np.asarray(batch[ACTIONS]),
+                  np.asarray(batch[REWARDS]), next_obs,
+                  np.asarray(batch[DONES]),
+                  np.asarray(self._probs(next_obs)), self.gamma,
+                  backups=self._q_backups)
+        return model
+
+
+class DirectMethod(_ModelBasedEstimator):
+    """DM (reference: ``offline/estimators/direct_method.py``):
+    V_target = mean over episodes of E_{a~pi} Q_fqe(s0, a) — pure model
+    extrapolation, zero variance from importance weights, biased by
+    whatever the Q-model gets wrong."""
+
+    def estimate(self, batch: SampleBatch) -> Dict[str, float]:
+        model = self._fit_q(batch)
+        v_b = v_t = 0.0
+        n = 0
+        for ep in self._episodes(batch):
+            v_b += self._behavior_return(ep)
+            s0 = np.asarray(ep[OBS][:1], np.float32)
+            v_t += float(model.v_values(s0, self._probs(s0))[0])
+            n += 1
+        n = max(n, 1)
+        v_b, v_t = v_b / n, v_t / n
+        return {"v_behavior": v_b, "v_target": v_t,
+                "v_gain": v_t / v_b if v_b else float("nan")}
+
+
+class DoublyRobust(_ModelBasedEstimator):
+    """DR (reference: ``offline/estimators/doubly_robust.py``; Jiang &
+    Li 2016): the backward recursion
+    ``v_t = V(s_t) + rho_t * (r_t + gamma * v_{t+1} - Q(s_t, a_t))``
+    uses the FQE model as a control variate on importance sampling —
+    unbiased when the behavior logps are correct, with variance bounded
+    by the model's residuals instead of the raw returns."""
+
+    def estimate(self, batch: SampleBatch) -> Dict[str, float]:
+        model = self._fit_q(batch)
+        v_b = v_t = 0.0
+        n = 0
+        for ep in self._episodes(batch):
+            obs = np.asarray(ep[OBS], np.float32)
+            acts = np.asarray(ep[ACTIONS]).astype(np.int64)
+            rewards = np.asarray(ep[REWARDS], np.float64)
+            probs = np.asarray(self._probs(obs), np.float64)
+            q = model.q_values(obs).astype(np.float64)
+            v_model = np.sum(probs * q, axis=1)
+            q_taken = q[np.arange(len(acts)), acts]
+            pi_a = probs[np.arange(len(acts)), acts]
+            rho = pi_a / np.maximum(
+                np.exp(np.asarray(ep[LOGPS], np.float64)), 1e-12)
+            v = 0.0
+            for t in range(len(rewards) - 1, -1, -1):
+                v = v_model[t] + rho[t] * (
+                    rewards[t] + self.gamma * v - q_taken[t])
+            v_b += self._behavior_return(ep)
+            v_t += float(v)
+            n += 1
+        n = max(n, 1)
         v_b, v_t = v_b / n, v_t / n
         return {"v_behavior": v_b, "v_target": v_t,
                 "v_gain": v_t / v_b if v_b else float("nan")}

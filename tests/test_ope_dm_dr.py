@@ -1,0 +1,121 @@
+"""Direct-method and doubly-robust off-policy estimators (VERDICT r4
+item 10): accuracy on a known synthetic MDP and the DR-variance <= IS
+property that justifies the model-based machinery."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.offline import (DirectMethod, DoublyRobust,
+                                   ImportanceSampling, SampleBatch)
+from ray_tpu.rllib.sample_batch import (ACTIONS, DONES, LOGPS, NEXT_OBS,
+                                        OBS, REWARDS)
+
+D = 4  # obs feature dim
+A = 2
+
+
+def _reward(obs, act):
+    """Known reward: action 1 is better when obs[0] > 0."""
+    return np.where(act == 1, obs[:, 0], -obs[:, 0]).astype(np.float64)
+
+
+def _target_probs(obs):
+    obs = np.asarray(obs, np.float64)
+    logits = np.stack([-2.0 * obs[:, 0], 2.0 * obs[:, 0]], axis=1)
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def _target_logp(obs, actions):
+    p = _target_probs(obs)
+    return np.log(p[np.arange(len(actions)),
+                    np.asarray(actions).astype(np.int64)])
+
+
+def _logged_bandit(n, rng):
+    """One-step episodes, uniform-random behavior policy."""
+    obs = rng.normal(size=(n, D)).astype(np.float32)
+    act = rng.integers(0, A, size=n)
+    rew = _reward(obs, act)
+    return SampleBatch({
+        OBS: obs,
+        ACTIONS: act.astype(np.int64),
+        REWARDS: rew.astype(np.float32),
+        NEXT_OBS: np.zeros_like(obs),
+        DONES: np.ones(n, bool),
+        LOGPS: np.full(n, np.log(0.5), np.float32),
+    })
+
+
+def _true_v(obs):
+    """Exact target value on these contexts (1-step, known reward)."""
+    p = _target_probs(obs)
+    per = p[:, 0] * _reward(obs, np.zeros(len(obs), np.int64)) + \
+        p[:, 1] * _reward(obs, np.ones(len(obs), np.int64))
+    return float(per.mean())
+
+
+def _make(cls, **kw):
+    return cls(_target_logp, target_probs_fn=_target_probs,
+               num_actions=A, gamma=1.0, q_backups=5, **kw)
+
+
+def test_dm_and_dr_recover_true_value():
+    rng = np.random.default_rng(0)
+    batch = _logged_bandit(2000, rng)
+    truth = _true_v(np.asarray(batch[OBS]))
+    dm = _make(DirectMethod).estimate(batch)
+    dr = _make(DoublyRobust).estimate(batch)
+    isv = ImportanceSampling(_target_logp, gamma=1.0).estimate(batch)
+    for name, est in (("dm", dm), ("dr", dr), ("is", isv)):
+        assert abs(est["v_target"] - truth) < 0.15, (
+            f"{name}: {est['v_target']:.3f} vs truth {truth:.3f}")
+
+
+def test_dr_variance_not_worse_than_is():
+    """Across many small logged datasets, DR's estimator variance must
+    not exceed ordinary IS's (the control variate earning its keep)."""
+    rng = np.random.default_rng(1)
+    is_est, dr_est = [], []
+    for trial in range(12):
+        batch = _logged_bandit(150, rng)
+        is_est.append(ImportanceSampling(
+            _target_logp, gamma=1.0).estimate(batch)["v_target"])
+        dr_est.append(_make(DoublyRobust).estimate(batch)["v_target"])
+    v_is = float(np.var(is_est))
+    v_dr = float(np.var(dr_est))
+    assert v_dr <= v_is * 1.05, (
+        f"DR variance {v_dr:.4f} vs IS {v_is:.4f}")
+
+
+def test_dr_multi_step_chain():
+    """Two-step episodes: the backward recursion must discount and
+    bootstrap correctly (not just the bandit special case)."""
+    rng = np.random.default_rng(2)
+    n_ep = 400
+    obs0 = rng.normal(size=(n_ep, D)).astype(np.float32)
+    act0 = rng.integers(0, A, size=n_ep)
+    obs1 = rng.normal(size=(n_ep, D)).astype(np.float32)
+    act1 = rng.integers(0, A, size=n_ep)
+    rows = {
+        OBS: np.empty((2 * n_ep, D), np.float32),
+        NEXT_OBS: np.empty((2 * n_ep, D), np.float32),
+        ACTIONS: np.empty(2 * n_ep, np.int64),
+        REWARDS: np.empty(2 * n_ep, np.float32),
+        DONES: np.tile([False, True], n_ep),
+        LOGPS: np.full(2 * n_ep, np.log(0.5), np.float32),
+    }
+    rows[OBS][0::2], rows[OBS][1::2] = obs0, obs1
+    rows[NEXT_OBS][0::2] = obs1
+    rows[NEXT_OBS][1::2] = np.zeros_like(obs1)
+    rows[ACTIONS][0::2], rows[ACTIONS][1::2] = act0, act1
+    rows[REWARDS][0::2] = _reward(obs0, act0)
+    rows[REWARDS][1::2] = _reward(obs1, act1)
+    batch = SampleBatch(rows)
+    gamma = 0.9
+    truth = _true_v(obs0) + gamma * _true_v(obs1)
+    dr = DoublyRobust(_target_logp, target_probs_fn=_target_probs,
+                      num_actions=A, gamma=gamma,
+                      q_backups=10).estimate(batch)
+    assert abs(dr["v_target"] - truth) < 0.2, (
+        f"DR {dr['v_target']:.3f} vs truth {truth:.3f}")
