@@ -16,6 +16,23 @@ from .env import (
     VectorEnv,
     make_env,
 )
+from .connectors import (
+    ActionConnector,
+    ActionConnectorPipeline,
+    AgentConnector,
+    AgentConnectorPipeline,
+    ConnectorContext,
+    create_connectors_for_policy,
+    register_connector,
+    restore_connectors_for_policy,
+)
+from .external import (
+    ExternalDQNWorker,
+    ExternalEnv,
+    ExternalEnvWorker,
+    PolicyClient,
+    PolicyServerInput,
+)
 from .impala import Impala, ImpalaConfig, vtrace
 from .multi_agent import MultiAgentEnv, make_multi_agent, sample_multi_agent
 from .offline import (
@@ -54,6 +71,12 @@ __all__ = [
     "JsonReader",
     "JsonWriter",
     "WeightedImportanceSampling",
+    "ActionConnector", "ActionConnectorPipeline", "AgentConnector",
+    "AgentConnectorPipeline", "ConnectorContext",
+    "create_connectors_for_policy", "register_connector",
+    "restore_connectors_for_policy",
+    "ExternalDQNWorker", "ExternalEnv", "ExternalEnvWorker",
+    "PolicyClient", "PolicyServerInput",
     "Algorithm", "AlgorithmConfig", "ApexConfig", "ApexDQN",
     "AtariSim", "DQN", "DQNConfig",
     "FastCartPole", "FastPendulum", "GymVectorEnv", "Impala",

@@ -223,12 +223,24 @@ class Algorithm:
         self.set_state(state)
 
     def get_state(self) -> Dict:
-        return {"iteration": self.iteration,
-                "timesteps_total": self._timesteps_total}
+        state = {"iteration": self.iteration,
+                 "timesteps_total": self._timesteps_total}
+        try:
+            state["connectors"] = \
+                self.workers.local_worker.connector_state()
+        except Exception:
+            # Lambda connectors are explicitly non-serializable; the
+            # rest of the checkpoint still saves.
+            pass
+        return state
 
     def set_state(self, state: Dict) -> None:
         self.iteration = state.get("iteration", 0)
         self._timesteps_total = state.get("timesteps_total", 0)
+        conn = state.get("connectors")
+        if conn is not None:
+            self.workers.foreach_worker(
+                lambda w: w.restore_connector_state(conn))
 
     def stop(self) -> None:
         self.workers.stop()

@@ -100,7 +100,7 @@ class DQNRolloutWorker(RolloutWorker):
 
     def _make_policy(self, cfg: Dict, seed: int):
         return QPolicy(
-            self.env.observation_space_shape, self.env.num_actions,
+            self._connected_obs_shape, self.env.num_actions,
             hidden=cfg.get("hidden", (256, 256)), seed=seed,
         )
 
@@ -109,7 +109,7 @@ class DQNRolloutWorker(RolloutWorker):
 
     def sample(self, rollout_length: int = 64) -> SampleBatch:
         n = self.env.num_envs
-        shape = tuple(self.env.observation_space_shape)
+        shape = self._connected_obs_shape
         obs_buf = np.empty((rollout_length, n) + shape, np.float32)
         nobs_buf = np.empty((rollout_length, n) + shape, np.float32)
         act_buf = np.empty((rollout_length, n), np.int32)
@@ -119,16 +119,12 @@ class DQNRolloutWorker(RolloutWorker):
             actions, _, _ = self.policy.compute_actions(self._obs)
             obs_buf[t] = self._obs
             act_buf[t] = actions
-            next_obs, rewards, dones, _ = self.env.vector_step(actions)
             # next_obs at a done is the auto-reset obs; the (1 - done)
             # mask in the TD target makes the bootstrap ignore it.
+            next_obs, rewards, dones, _ = self._step_env(actions)
             nobs_buf[t] = next_obs
             rew_buf[t] = rewards
             done_buf[t] = dones
-            self._episode_rewards += rewards
-            for i in np.nonzero(dones)[0]:
-                self._completed.append(float(self._episode_rewards[i]))
-                self._episode_rewards[i] = 0.0
             self._obs = next_obs
         flat = lambda a: a.reshape((-1,) + a.shape[2:])
         return SampleBatch({

@@ -175,20 +175,35 @@ class MARWIL(Algorithm):
         }
 
     def evaluate(self, episodes: int = 5) -> Dict:
-        """Roll the learned policy out in the WorkerSet's env."""
+        """Roll the learned policy out through the worker's connector
+        pipelines (eval mode: running stats frozen)."""
         worker = self.workers.local_worker
         env = worker.env
         rewards = []
-        obs = env.vector_reset(seed=self.config.seed + 99)
-        ep_rew = np.zeros(env.num_envs, np.float32)
-        while len(rewards) < episodes:
-            actions, _, _ = worker.policy.compute_actions(
-                obs, deterministic=True)
-            obs, r, dones, _ = env.vector_step(actions)
-            ep_rew += r
-            for i in np.nonzero(dones)[0]:
-                rewards.append(float(ep_rew[i]))
-                ep_rew[i] = 0.0
+        worker.agent_connectors.in_eval()
+        worker.agent_connectors.reset()
+        try:
+            obs = worker.agent_connectors(
+                env.vector_reset(seed=self.config.seed + 99))
+            ep_rew = np.zeros(env.num_envs, np.float32)
+            while len(rewards) < episodes:
+                actions, _, _ = worker.policy.compute_actions(
+                    obs, deterministic=True)
+                nobs, r, dones, _ = env.vector_step(
+                    worker.action_connectors(actions))
+                worker.agent_connectors.on_episode_done(dones)
+                obs = worker.agent_connectors(nobs)
+                ep_rew += r
+                for i in np.nonzero(dones)[0]:
+                    rewards.append(float(ep_rew[i]))
+                    ep_rew[i] = 0.0
+        finally:
+            worker.agent_connectors.in_training()
+            worker.agent_connectors.reset()
+            # Re-align the worker's stepping state with its env, which
+            # this loop advanced out from under sample().
+            worker._obs = worker.agent_connectors(
+                env.vector_reset(seed=self.config.seed + 100))
         return {"episode_reward_mean": float(np.mean(rewards)),
                 "episodes": len(rewards)}
 

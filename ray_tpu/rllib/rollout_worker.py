@@ -13,6 +13,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from .connectors import ConnectorContext, create_connectors_for_policy
 from .env import make_env
 from .policy import JaxPolicy
 from .sample_batch import (
@@ -42,9 +43,18 @@ class RolloutWorker:
         except Exception:
             pass
         self.env = make_env(env_spec, num_envs, seed + worker_index * 1000)
-        self.policy = self._make_policy(policy_config or {},
-                                        seed + worker_index)
-        self._obs = self.env.vector_reset(seed=seed + worker_index * 1000)
+        cfg = policy_config or {}
+        # Connector pipelines sit between env and policy (reference:
+        # connectors/util.py create_connectors_for_policy) — the policy
+        # is built against the TRANSFORMED obs shape, and the batch
+        # stores transformed observations (what the policy actually saw).
+        ctx = ConnectorContext.from_env(self.env, cfg)
+        self.agent_connectors, self.action_connectors = \
+            create_connectors_for_policy(ctx, cfg.get("connectors"))
+        raw = self.env.vector_reset(seed=seed + worker_index * 1000)
+        self._obs = self.agent_connectors(raw)
+        self._connected_obs_shape = tuple(np.asarray(self._obs).shape[1:])
+        self.policy = self._make_policy(cfg, seed + worker_index)
         self._episode_rewards = np.zeros(self.env.num_envs, np.float32)
         self._completed: list = []
         self.worker_index = worker_index
@@ -52,7 +62,7 @@ class RolloutWorker:
     def _make_policy(self, cfg: Dict, seed: int):
         """Subclass hook: build the policy for this worker's env."""
         return JaxPolicy(
-            self.env.observation_space_shape, self.env.num_actions,
+            self._connected_obs_shape, self.env.num_actions,
             hidden=cfg.get("hidden", (64, 64)), seed=seed,
             network=cfg.get("network", "auto"),
             model_config=cfg.get("model"),
@@ -61,6 +71,37 @@ class RolloutWorker:
     def apply(self, fn) -> Any:
         """Run fn(self) in the worker (reference: RolloutWorker.apply)."""
         return fn(self)
+
+    def _step_env(self, actions: np.ndarray):
+        """One connected env step: action pipeline -> env.step -> agent
+        pipeline on (obs, rewards) -> episode bookkeeping. Returns
+        (transformed_next_obs, transformed_rewards, dones, infos)."""
+        env_actions = self.action_connectors(actions)
+        next_obs, rewards, dones, infos = self.env.vector_step(env_actions)
+        self._episode_rewards += rewards
+        for i in np.nonzero(dones)[0]:
+            self._completed.append(float(self._episode_rewards[i]))
+            self._episode_rewards[i] = 0.0
+        self.agent_connectors.on_episode_done(dones)
+        return (self.agent_connectors(next_obs),
+                self.agent_connectors.transform_reward(rewards),
+                dones, infos)
+
+    def connector_state(self) -> Dict:
+        """Serialized pipelines — Algorithm.get_state embeds this so a
+        restored run (or a served policy) reconstructs the exact
+        preprocessing, running statistics included (reference:
+        connectors/util.py restore_connectors_for_policy)."""
+        return {"agent": self.agent_connectors.to_state(),
+                "action": self.action_connectors.to_state()}
+
+    def restore_connector_state(self, state: Dict) -> None:
+        from .connectors import (ConnectorContext,
+                                 restore_connectors_for_policy)
+
+        ctx = ConnectorContext.from_env(self.env)
+        self.agent_connectors, self.action_connectors = \
+            restore_connectors_for_policy(ctx, state)
 
     def set_weights(self, weights: Dict) -> None:
         self.policy.set_weights(weights)
@@ -86,7 +127,7 @@ class RolloutWorker:
         # make the training batch see a DIFFERENT function than the one
         # that sampled the actions (breaking the PPO importance ratio).
         obs_buf = np.empty((rollout_length, n) +
-                           tuple(self.env.observation_space_shape),
+                           self._connected_obs_shape,
                            np.asarray(self._obs).dtype)
         act_buf = np.empty((rollout_length, n), np.int32)
         logp_buf = np.empty((rollout_length, n), np.float32)
@@ -99,13 +140,9 @@ class RolloutWorker:
             act_buf[t] = actions
             logp_buf[t] = logp
             vf_buf[t] = values
-            next_obs, rewards, dones, _ = self.env.vector_step(actions)
+            next_obs, rewards, dones, _ = self._step_env(actions)
             rew_buf[t] = rewards
             done_buf[t] = dones
-            self._episode_rewards += rewards
-            for i in np.nonzero(dones)[0]:
-                self._completed.append(float(self._episode_rewards[i]))
-                self._episode_rewards[i] = 0.0
             # Recurrent policies reset finished sub-envs' state slots.
             observe = getattr(self.policy, "observe_dones", None)
             if observe is not None:

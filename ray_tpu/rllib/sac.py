@@ -161,14 +161,14 @@ class SACRolloutWorker(RolloutWorker):
 
     def _make_policy(self, cfg: Dict, seed: int):
         return SACPolicy(
-            self.env.observation_space_shape, self.env.action_dim,
+            self._connected_obs_shape, self.env.action_dim,
             self.env.action_low, self.env.action_high,
             hidden=cfg.get("hidden", (256, 256)), seed=seed,
         )
 
     def sample(self, rollout_length: int = 64) -> SampleBatch:
         n = self.env.num_envs
-        shape = tuple(self.env.observation_space_shape)
+        shape = self._connected_obs_shape
         adim = self.env.action_dim
         obs_buf = np.empty((rollout_length, n) + shape, np.float32)
         nobs_buf = np.empty((rollout_length, n) + shape, np.float32)
@@ -179,14 +179,10 @@ class SACRolloutWorker(RolloutWorker):
             actions, _, _ = self.policy.compute_actions(self._obs)
             obs_buf[t] = self._obs
             act_buf[t] = actions.reshape(n, adim)
-            next_obs, rewards, dones, _ = self.env.vector_step(actions)
+            next_obs, rewards, dones, _ = self._step_env(actions)
             nobs_buf[t] = next_obs
             rew_buf[t] = rewards
             done_buf[t] = dones
-            self._episode_rewards += rewards
-            for i in np.nonzero(dones)[0]:
-                self._completed.append(float(self._episode_rewards[i]))
-                self._episode_rewards[i] = 0.0
             self._obs = next_obs
         flat = lambda a: a.reshape((-1,) + a.shape[2:])
         return SampleBatch({

@@ -101,7 +101,7 @@ class TD3Policy:
 class TD3RolloutWorker(SACRolloutWorker):
     def _make_policy(self, cfg: Dict, seed: int):
         return TD3Policy(
-            self.env.observation_space_shape, self.env.action_dim,
+            self._connected_obs_shape, self.env.action_dim,
             self.env.action_low, self.env.action_high,
             hidden=cfg.get("hidden", (256, 256)), seed=seed,
             explore_sigma=cfg.get("explore_sigma", 0.1),

@@ -1,0 +1,189 @@
+"""Connector pipeline tests (reference: rllib/connectors/tests/)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.connectors import (
+    ActionConnectorPipeline,
+    AgentConnectorPipeline,
+    ClipActionConnector,
+    ClipRewardConnector,
+    ConnectorContext,
+    FrameStackConnector,
+    MeanStdObsConnector,
+    NormalizeActionConnector,
+    create_connectors_for_policy,
+    restore_connectors_for_policy,
+)
+
+
+def _ctx(**kw):
+    defaults = dict(obs_shape=(4,), num_actions=2, num_envs=3)
+    defaults.update(kw)
+    return ConnectorContext(**defaults)
+
+
+def test_frame_stack_stacks_and_resets():
+    ctx = _ctx()
+    fs = FrameStackConnector(ctx, k=3)
+    o1 = np.ones((2, 4), np.float32)
+    out = fs(o1)
+    assert out.shape == (2, 12)
+    np.testing.assert_array_equal(out, np.ones((2, 12)))
+    o2 = 2 * np.ones((2, 4), np.float32)
+    out = fs(o2)
+    # history shifts: [o1, o1, o2]
+    np.testing.assert_array_equal(out[:, :8], np.ones((2, 8)))
+    np.testing.assert_array_equal(out[:, 8:], 2 * np.ones((2, 4)))
+    # env slot 0 finishes; its next frame is a reset obs and must fill
+    # the whole history (no leakage from the dead episode).
+    fs.on_episode_done(np.array([True, False]))
+    o3 = np.stack([7 * np.ones(4), 3 * np.ones(4)]).astype(np.float32)
+    out = fs(o3)
+    np.testing.assert_array_equal(out[0], 7 * np.ones(12))
+    np.testing.assert_array_equal(out[1, 8:], 3 * np.ones(4))
+    np.testing.assert_array_equal(out[1, 4:8], 2 * np.ones(4))
+
+
+def test_mean_std_normalizes_and_freezes_in_eval():
+    ctx = _ctx()
+    ms = MeanStdObsConnector(ctx)
+    rng = np.random.default_rng(0)
+    data = rng.normal(5.0, 3.0, size=(200, 4)).astype(np.float32)
+    for i in range(0, 200, 20):
+        out = ms(data[i:i + 20])
+    # After plenty of data, outputs are ~standardized.
+    assert abs(float(out.mean())) < 0.5
+    assert 0.5 < float(out.std()) < 2.0
+    count = ms.count
+    ms.in_eval()
+    ms(np.zeros((10, 4), np.float32))
+    assert ms.count == count  # frozen
+
+
+def test_mean_std_serialization_round_trip():
+    ctx = _ctx()
+    ms = MeanStdObsConnector(ctx)
+    rng = np.random.default_rng(1)
+    ms(rng.normal(2.0, 0.5, size=(64, 4)).astype(np.float32))
+    name, params = ms.to_state()
+    ms2 = MeanStdObsConnector.from_state(ctx, params)
+    x = rng.normal(2.0, 0.5, size=(8, 4)).astype(np.float32)
+    ms.in_eval(), ms2.in_eval()
+    np.testing.assert_allclose(ms(x), ms2(x), rtol=1e-6)
+
+
+def test_action_normalize_then_clip():
+    ctx = _ctx(action_low=np.array([-2.0]), action_high=np.array([2.0]))
+    pipe = ActionConnectorPipeline(
+        ctx, [NormalizeActionConnector(ctx), ClipActionConnector(ctx)])
+    a = np.array([[-1.0], [0.0], [1.0], [5.0]], np.float32)
+    out = pipe(a)
+    np.testing.assert_allclose(out[:, 0], [-2.0, 0.0, 2.0, 2.0])
+
+
+def test_clip_reward_sign_and_limit():
+    ctx = _ctx()
+    sign = ClipRewardConnector(ctx, sign=True)
+    np.testing.assert_array_equal(
+        sign.transform_reward(np.array([-3.0, 0.0, 9.1])), [-1, 0, 1])
+    lim = ClipRewardConnector(ctx, limit=1.5)
+    np.testing.assert_allclose(
+        lim.transform_reward(np.array([-3.0, 0.5, 9.1])), [-1.5, 0.5, 1.5])
+
+
+def test_pipeline_spec_and_restore_round_trip():
+    ctx = _ctx()
+    agent, action = create_connectors_for_policy(ctx, {
+        "agent": [("FrameStack", {"k": 2}), "MeanStdObs",
+                  ("ClipReward", {"limit": 1.0})],
+        "action": ["ImmutableAction"],
+    })
+    obs = np.random.default_rng(2).normal(size=(3, 4)).astype(np.float32)
+    out = agent(obs)
+    assert out.shape == (3, 8)
+    state = {"agent": agent.to_state(), "action": action.to_state()}
+    agent2, action2 = restore_connectors_for_policy(ctx, state)
+    agent.in_eval(), agent2.in_eval()
+    # FrameStack history is runtime state (not serialized); feed the same
+    # obs twice so both pipelines are warmed identically.
+    np.testing.assert_allclose(agent(obs), agent2(obs), rtol=1e-6)
+    acts = action2(np.array([1, 0, 1]))
+    with pytest.raises(ValueError):
+        acts[0] = 5  # immutable
+
+
+def test_pipeline_insert_remove():
+    ctx = _ctx()
+    agent, _ = create_connectors_for_policy(
+        ctx, {"agent": ["MeanStdObs"]})
+    agent.prepend(FrameStackConnector(ctx, k=2))
+    assert [type(c).__name__ for c in agent.connectors] == \
+        ["FrameStackConnector", "MeanStdObsConnector"]
+    agent.remove("MeanStdObs")
+    assert len(agent.connectors) == 1
+
+
+def test_rollout_worker_with_connectors_learns_shapes():
+    """RolloutWorker builds its policy against the TRANSFORMED obs shape
+    and records transformed obs in the batch."""
+    from ray_tpu.rllib.rollout_worker import RolloutWorker
+    from ray_tpu.rllib.sample_batch import OBS
+
+    w = RolloutWorker(
+        "FastCartPole", num_envs=4,
+        policy_config={"connectors": {
+            "agent": [("FrameStack", {"k": 2}), "MeanStdObs"],
+            "action": ["ImmutableAction"],
+        }},
+    )
+    assert w._connected_obs_shape == (8,)
+    batch = w.sample(rollout_length=16)
+    assert batch[OBS].shape == (16, 4, 8)
+    state = w.connector_state()
+    assert [n for n, _ in state["agent"]] == \
+        ["FrameStack", "MeanStdObs", ]
+    assert [n for n, _ in state["action"]] == ["ImmutableAction"]
+
+
+def test_connector_state_survives_algorithm_checkpoint(tmp_path):
+    """MeanStd statistics ride the Algorithm save/restore round trip
+    (a restored policy must see the SAME normalization it trained on)."""
+    from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+    w = RolloutWorker("FastCartPole", num_envs=4, policy_config={
+        "connectors": {"agent": ["MeanStdObs"]}})
+    w.sample(rollout_length=32)
+    ms = w.agent_connectors.connectors[0]
+    assert ms.count > 0
+    state = w.connector_state()
+
+    w2 = RolloutWorker("FastCartPole", num_envs=4, policy_config={
+        "connectors": {"agent": ["MeanStdObs"]}})
+    w2.restore_connector_state(state)
+    ms2 = w2.agent_connectors.connectors[0]
+    assert ms2.count == ms.count
+    np.testing.assert_allclose(ms2.mean, ms.mean)
+
+
+def test_external_env_rejects_slot_stateful_and_probes_shape():
+    from ray_tpu.rllib.external import ExternalEnv, ExternalEnvWorker
+
+    class Dummy(ExternalEnv):
+        def __init__(self):
+            super().__init__(obs_shape=(4,), num_actions=2)
+
+        def run(self):
+            import time
+            time.sleep(60)
+
+    with pytest.raises(ValueError, match="slot-stateful"):
+        ExternalEnvWorker(Dummy(), policy_config={
+            "connectors": {"agent": [("FrameStack", {"k": 4})]}})
+
+    # MeanStdObs is fine, the probe must not pollute its statistics,
+    # and the policy input dim follows the transformed shape.
+    w = ExternalEnvWorker(Dummy(), policy_config={
+        "connectors": {"agent": ["MeanStdObs"]}})
+    assert w._connected_obs_shape == (4,)
+    assert w.agent_connectors.connectors[0].count == 0
