@@ -31,6 +31,7 @@ import queue
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -83,7 +84,11 @@ class ExternalEnv(threading.Thread):
         self.num_envs = 1  # batch dim is dynamic (concurrent episodes)
         self._max_concurrent = max_concurrent
         self._episodes: Dict[str, _Episode] = {}
-        self._finished: set = set()
+        # Recent finished ids only (duplicate-end detection): unbounded
+        # retention would leak one uuid per episode in a server that
+        # runs for days.
+        self._finished: "OrderedDict[str, None]" = OrderedDict()
+        self._finished_cap = 10_000
         self._lock = threading.Lock()
         # (episode, obs) pairs waiting for an on-policy action.
         self._pending: "queue.Queue" = queue.Queue()
@@ -137,7 +142,9 @@ class ExternalEnv(threading.Thread):
         ep = self._get(episode_id)
         self._emit_step(ep, np.asarray(observation), done=True)
         with self._lock:
-            self._finished.add(episode_id)
+            self._finished[episode_id] = None
+            while len(self._finished) > self._finished_cap:
+                self._finished.popitem(last=False)
             self._episodes.pop(episode_id, None)
             self._completed_returns.append(ep.total_reward)
 
@@ -194,6 +201,7 @@ class ExternalEnvWorker(RolloutWorker):
                             "instance or factory")
         self.env = env
         cfg = policy_config or {}
+        self._policy_cfg = cfg
         ctx = ConnectorContext.from_env(env, cfg)
         self.agent_connectors, self.action_connectors = \
             create_connectors_for_policy(ctx, cfg.get("connectors"))
@@ -313,6 +321,11 @@ class PolicyServerInput(ExternalEnv):
     ``http://host:port``. Reference: ``PolicyServerInput``
     (policy_server_input.py:29) — same command protocol, minus the
     local-inference weight sync.
+
+    .. warning:: Requests are **unpickled** (as in the reference), which
+       is remote code execution for anyone who can reach the port. Bind
+       to localhost (the default) or a trusted network only — never
+       expose this port publicly.
     """
 
     def __init__(self, obs_shape: Tuple[int, ...], num_actions: int,
@@ -381,6 +394,7 @@ class PolicyClient:
         self.timeout_s = timeout_s
 
     def _send(self, **req) -> Any:
+        import urllib.error
         import urllib.request
 
         data = pickle.dumps(req)

@@ -49,6 +49,7 @@ class RolloutWorker:
         # is built against the TRANSFORMED obs shape, and the batch
         # stores transformed observations (what the policy actually saw).
         ctx = ConnectorContext.from_env(self.env, cfg)
+        self._policy_cfg = cfg
         self.agent_connectors, self.action_connectors = \
             create_connectors_for_policy(ctx, cfg.get("connectors"))
         raw = self.env.vector_reset(seed=seed + worker_index * 1000)
@@ -91,15 +92,31 @@ class RolloutWorker:
         """Serialized pipelines — Algorithm.get_state embeds this so a
         restored run (or a served policy) reconstructs the exact
         preprocessing, running statistics included (reference:
-        connectors/util.py restore_connectors_for_policy)."""
-        return {"agent": self.agent_connectors.to_state(),
-                "action": self.action_connectors.to_state()}
+        connectors/util.py restore_connectors_for_policy).
+
+        Non-serializable connectors (lambdas) are skipped with a warning
+        rather than poisoning the whole checkpoint — losing a stateless
+        lambda is recoverable; silently losing MeanStd statistics is not.
+        """
+        import warnings
+
+        state: Dict = {"agent": [], "action": []}
+        for key, pipe in (("agent", self.agent_connectors),
+                          ("action", self.action_connectors)):
+            for c in pipe.connectors:
+                try:
+                    state[key].append(c.to_state())
+                except Exception:
+                    warnings.warn(
+                        f"connector {type(c).__name__} is not "
+                        "serializable; omitted from checkpoint — "
+                        "re-add it in the config on restore")
+        return state
 
     def restore_connector_state(self, state: Dict) -> None:
-        from .connectors import (ConnectorContext,
-                                 restore_connectors_for_policy)
+        from .connectors import restore_connectors_for_policy
 
-        ctx = ConnectorContext.from_env(self.env)
+        ctx = ConnectorContext.from_env(self.env, self._policy_cfg)
         self.agent_connectors, self.action_connectors = \
             restore_connectors_for_policy(ctx, state)
 

@@ -7,12 +7,14 @@ from .schedulers import (
     AsyncHyperBandScheduler,
     FIFOScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialDecision,
     TrialScheduler,
 )
 from .search import (
     BasicVariantGenerator,
+    BOHBSearcher,
     Choice,
     Domain,
     GridSearch,
@@ -20,6 +22,7 @@ from .search import (
     RandomSearch,
     TPESearcher,
     Searcher,
+    create_bohb,
     choice,
     grid_search,
     loguniform,
@@ -38,9 +41,11 @@ from .tuner import (
 )
 
 __all__ = [
-    "AsyncHyperBandScheduler", "BasicVariantGenerator", "Choice", "Domain",
-    "FIFOScheduler", "GridSearch", "MedianStoppingRule",
-    "ConcurrencyLimiter", "PopulationBasedTraining", "RandomSearch", "ResultGrid", "Searcher", "TPESearcher",
+    "AsyncHyperBandScheduler", "BOHBSearcher", "BasicVariantGenerator",
+    "Choice", "Domain",
+    "FIFOScheduler", "GridSearch", "MedianStoppingRule", "PB2",
+    "ConcurrencyLimiter", "PopulationBasedTraining", "RandomSearch",
+    "ResultGrid", "Searcher", "TPESearcher", "create_bohb",
     "Trial", "TrialDecision", "TrialRunner", "TrialScheduler", "TrialStatus",
     "TuneConfig", "Tuner", "choice", "grid_search", "loguniform", "randint",
     "report", "run", "uniform",
