@@ -1,59 +1,441 @@
-"""Runtime environments: per-task/actor execution context.
+"""Runtime environments: per-task/actor execution context, plugin-based.
 
 Reference analog: ``python/ray/runtime_env/runtime_env.py`` (public
-RuntimeEnv) + ``_private/runtime_env/{working_dir,py_modules,pip,conda}``.
-Supported natively here: ``env_vars`` (applied in the worker before
-execution), ``working_dir`` (staged to a per-job dir and chdir'd,
-sys.path-prepended), ``py_modules`` (paths prepended to sys.path).
-``pip``/``conda`` are declared-but-gated: this environment forbids
-installs, so they validate and raise unless the packages already import.
+RuntimeEnv) + ``_private/runtime_env/plugin.py`` (RuntimeEnvPlugin /
+RuntimeEnvPluginManager) + ``_private/runtime_env/{working_dir,
+py_modules,pip,conda,container}.py`` + ``uri_cache.py``.
+
+Architecture (mirrors the reference's agent-side plugin manager, applied
+in-worker because workers here are generic processes, not per-env
+processes):
+
+- Each runtime_env field is owned by a :class:`RuntimeEnvPlugin` with
+  ``validate`` / ``get_uri`` / ``create`` / ``modify_context`` /
+  ``delete_uri`` hooks. Plugins run in ascending ``priority`` order
+  (reference: RAY_RUNTIME_ENV_PRIORITY_FIELD_NAME ordering).
+- ``create`` materializes cacheable resources keyed by URI; a process-
+  wide :class:`URICache` tracks bytes and evicts least-recently-used
+  materializations beyond its cap (reference: uri_cache.py).
+- Custom plugins register via :func:`register_plugin` or the
+  ``RT_RUNTIME_ENV_PLUGINS`` env var (comma-separated ``module:attr``
+  import paths — reference: RAY_RUNTIME_ENV_PLUGINS_ENV_VAR).
+- ``conda`` and ``container`` are *declared-but-gated*: this environment
+  forbids network installs and has no container runtime, so their
+  plugins validate the schema and raise actionable errors (or no-op when
+  the named env is already active).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import shutil
 import sys
 import tempfile
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _pip_env_key(pip: List[str], wheel_dir: str) -> str:
+    """THE cache key for a pip materialization — single source for
+    get_uri, site-path resolution, and materialize_pip_env."""
+    return hashlib.sha1(json.dumps(
+        [sorted(pip), os.path.abspath(wheel_dir)]).encode()
+    ).hexdigest()[:16]
+
+
+def _pip_site(key: str) -> str:
+    return os.path.join(tempfile.gettempdir(), "rt_runtime_env", "pip",
+                        key)
 
 
 class RuntimeEnv(dict):
-    """Validated runtime environment description."""
+    """Validated runtime environment description.
 
-    KNOWN = {"env_vars", "working_dir", "py_modules", "pip", "conda",
-             "pip_wheel_dir"}
+    Validation is delegated per-field to the owning plugin
+    (reference: RuntimeEnv.__init__ calls each plugin's validate)."""
 
     def __init__(self, env_vars: Optional[Dict[str, str]] = None,
                  working_dir: Optional[str] = None,
                  py_modules: Optional[List[str]] = None,
                  pip: Optional[List[str]] = None,
                  conda: Optional[Any] = None,
+                 container: Optional[Dict] = None,
                  pip_wheel_dir: Optional[str] = None, **kwargs):
-        unknown = set(kwargs) - self.KNOWN
+        known = set(_PLUGINS) | {"pip_wheel_dir"}
+        unknown = set(kwargs) - known
         if unknown:
-            raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
+            raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}"
+                             f" (known: {sorted(known)})")
         super().__init__()
+        fields = {"env_vars": env_vars, "working_dir": working_dir,
+                  "py_modules": py_modules, "pip": pip, "conda": conda,
+                  "container": container, **kwargs}
         if pip_wheel_dir:
             self["pip_wheel_dir"] = os.path.abspath(pip_wheel_dir)
-        if env_vars:
-            if not all(isinstance(k, str) and isinstance(v, str)
-                       for k, v in env_vars.items()):
-                raise TypeError("env_vars must be Dict[str, str]")
-            self["env_vars"] = dict(env_vars)
-        if working_dir:
-            if not os.path.isdir(working_dir):
-                raise ValueError(f"working_dir {working_dir!r} not found")
-            self["working_dir"] = os.path.abspath(working_dir)
-        if py_modules:
-            for m in py_modules:
-                if not os.path.exists(m):
-                    raise ValueError(f"py_module path {m!r} not found")
-            self["py_modules"] = [os.path.abspath(m) for m in py_modules]
-        if pip:
-            self["pip"] = list(pip)
-        if conda:
-            self["conda"] = conda
+        for name, value in fields.items():
+            if value is None or value == [] or value == {}:
+                continue
+            plugin = _PLUGINS.get(name)
+            if plugin is None:
+                raise ValueError(f"no plugin registered for {name!r}")
+            self[name] = plugin.validate(value, self)
+
+
+class RuntimeEnvContext:
+    """What plugins mutate; the worker applies + undoes it
+    (reference: runtime_env/context.py RuntimeEnvContext — there it
+    builds the worker command line; here workers are already running, so
+    the context records process mutations and how to revert them)."""
+
+    def __init__(self):
+        self.env_vars: Dict[str, str] = {}
+        self.sys_paths: List[str] = []
+        self.working_dir: Optional[str] = None
+
+    def apply(self) -> Dict[str, Any]:
+        undo: Dict[str, Any] = {}
+        if self.env_vars:
+            undo["env_vars"] = {k: os.environ.get(k)
+                                for k in self.env_vars}
+            os.environ.update(self.env_vars)
+        if self.working_dir:
+            undo["cwd"] = os.getcwd()
+            os.chdir(self.working_dir)
+        # Each path is inserted at 0 in plugin-priority order, so LATER
+        # plugins end up in FRONT: pip-materialized packages shadow
+        # py_modules, which shadow working_dir — a pinned pip version
+        # must beat a stale copy sitting in the working dir.
+        for p in self.sys_paths:
+            sys.path.insert(0, p)
+        if self.sys_paths:
+            undo["extra_paths"] = list(self.sys_paths)
+        return undo
+
+
+class URICache:
+    """LRU byte-capped cache of materialized resources
+    (reference: _private/runtime_env/uri_cache.py)."""
+
+    def __init__(self, max_total_bytes: int = 2 * 1024 ** 3):
+        self.max_total_bytes = max_total_bytes
+        self._entries: Dict[str, int] = {}  # uri -> bytes (LRU order)
+        self._deleters: Dict[str, Callable[[str], int]] = {}
+        self._pins: Dict[str, int] = {}  # uri -> refcount
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self._entries.values())
+
+    def mark_used(self, uri: str) -> bool:
+        if uri in self._entries:
+            self._entries[uri] = self._entries.pop(uri)  # move to MRU
+            return True
+        return False
+
+    def pin(self, uri: str) -> None:
+        """A pinned URI is in use by an applied env; never evicted
+        (reference: uri_cache marks added URIs 'in use')."""
+        self._pins[uri] = self._pins.get(uri, 0) + 1
+
+    def unpin(self, uri: str) -> None:
+        n = self._pins.get(uri, 0) - 1
+        if n <= 0:
+            self._pins.pop(uri, None)
+        else:
+            self._pins[uri] = n
+
+    def add(self, uri: str, nbytes: int,
+            deleter: Callable[[str], int]) -> None:
+        self._entries.pop(uri, None)
+        self._entries[uri] = nbytes
+        self._deleters[uri] = deleter
+        self._evict()
+
+    def _evict(self) -> None:
+        candidates = [u for u in self._entries if u not in self._pins]
+        while self.total_bytes > self.max_total_bytes and len(
+                candidates) > 0 and len(self._entries) > 1:
+            uri = candidates.pop(0)  # least recently used, unpinned
+            self._entries.pop(uri)
+            deleter = self._deleters.pop(uri, None)
+            if deleter:
+                try:
+                    deleter(uri)
+                except OSError:
+                    pass
+
+
+_URI_CACHE = URICache()
+
+
+class RuntimeEnvPlugin:
+    """Base plugin (reference: plugin.py RuntimeEnvPlugin).
+
+    ``validate(value, env)`` returns the canonicalized value (raises on
+    bad input). ``get_uri`` names the cacheable resource (None = not
+    cacheable). ``create(uri, env)`` materializes it and returns
+    (path_or_none, bytes). ``modify_context`` records process mutations.
+    ``delete_uri`` reclaims space, returning bytes freed.
+    """
+
+    name: str = ""
+    priority: int = 10  # ascending execution order
+
+    def validate(self, value: Any, env: Dict) -> Any:
+        return value
+
+    def get_uri(self, env: Dict) -> Optional[str]:
+        return None
+
+    def check_uri(self, uri: str) -> bool:
+        """Is a cached URI's materialization still valid on disk?"""
+        return True
+
+    def create(self, uri: Optional[str], env: Dict) -> tuple:
+        return None, 0
+
+    def modify_context(self, uri: Optional[str], env: Dict,
+                       ctx: RuntimeEnvContext) -> None:
+        pass
+
+    def delete_uri(self, uri: str) -> int:
+        return 0
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+def _rmtree_bytes(path: str) -> int:
+    n = _dir_bytes(path)
+    shutil.rmtree(path, ignore_errors=True)
+    return n
+
+
+class EnvVarsPlugin(RuntimeEnvPlugin):
+    name = "env_vars"
+    priority = 1
+
+    def validate(self, value, env):
+        if not isinstance(value, dict) or not all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in value.items()):
+            raise TypeError("env_vars must be Dict[str, str]")
+        return dict(value)
+
+    def modify_context(self, uri, env, ctx):
+        ctx.env_vars.update(env.get("env_vars", {}))
+
+
+class WorkingDirPlugin(RuntimeEnvPlugin):
+    name = "working_dir"
+    priority = 5
+
+    def validate(self, value, env):
+        if not os.path.isdir(value):
+            raise ValueError(f"working_dir {value!r} not found")
+        return os.path.abspath(value)
+
+    def modify_context(self, uri, env, ctx):
+        wd = env.get("working_dir")
+        if wd:
+            ctx.working_dir = wd
+            ctx.sys_paths.append(wd)
+
+
+class PyModulesPlugin(RuntimeEnvPlugin):
+    name = "py_modules"
+    priority = 6
+
+    def validate(self, value, env):
+        for m in value:
+            if not os.path.exists(m):
+                raise ValueError(f"py_module path {m!r} not found")
+        return [os.path.abspath(m) for m in value]
+
+    def modify_context(self, uri, env, ctx):
+        for mod_path in env.get("py_modules", []):
+            parent = (os.path.dirname(mod_path)
+                      if os.path.isfile(mod_path) else mod_path)
+            ctx.sys_paths.append(parent)
+
+
+class PipPlugin(RuntimeEnvPlugin):
+    """Offline pip materialization, URI-cached per (packages, wheel dir)
+    hash (reference: _private/runtime_env/pip.py builds a venv per env
+    hash; installs here are ``--no-index`` from a local wheel dir)."""
+
+    name = "pip"
+    priority = 7
+
+    def validate(self, value, env):
+        if not isinstance(value, (list, tuple)) or not all(
+                isinstance(p, str) for p in value):
+            raise TypeError("pip must be a list of requirement strings")
+        return list(value)
+
+    def _wheel_dir(self, env: Dict) -> Optional[str]:
+        return env.get("pip_wheel_dir") or os.environ.get(
+            "RT_RUNTIME_ENV_WHEEL_DIR")
+
+    def get_uri(self, env: Dict) -> Optional[str]:
+        pip = env.get("pip")
+        wheel_dir = self._wheel_dir(env)
+        if not pip or not wheel_dir:
+            return None
+        return f"pip://{_pip_env_key(pip, wheel_dir)}"
+
+    def check_uri(self, uri: str) -> bool:
+        # The cache is per-process but the site dir lives in shared
+        # /tmp: another process (or a tmp cleaner) may have deleted it
+        # since we cached the URI — verify before trusting the hit.
+        return os.path.exists(os.path.join(self._site_for(uri),
+                                           ".rt_ready"))
+
+    def create(self, uri, env):
+        pip = env.get("pip") or []
+        wheel_dir = self._wheel_dir(env)
+        if not pip:
+            return None, 0
+        if not wheel_dir:
+            # NETWORK installs are forbidden here: without a local wheel
+            # dir the packages must already import.
+            for pkg in pip:
+                name = pkg.split("==")[0].split(">=")[0].replace("-", "_")
+                try:
+                    __import__(name)
+                except ImportError as e:
+                    raise RuntimeError(
+                        f"runtime_env pip package {pkg!r} unavailable; "
+                        "installs are disabled — provide pip_wheel_dir "
+                        "(or RT_RUNTIME_ENV_WHEEL_DIR) with local wheels"
+                    ) from e
+            return None, 0
+        site = materialize_pip_env(pip, wheel_dir)
+        return site, _dir_bytes(site)
+
+    @staticmethod
+    def _site_for(uri: str) -> str:
+        return _pip_site(uri.split("://", 1)[1])
+
+    def modify_context(self, uri, env, ctx):
+        # The site path is a pure function of the URI, so the cached-hit
+        # path (create skipped) resolves identically.
+        if uri is not None:
+            ctx.sys_paths.append(self._site_for(uri))
+
+    def delete_uri(self, uri: str) -> int:
+        target = self._site_for(uri)
+        if os.path.isdir(target):
+            return _rmtree_bytes(target)
+        return 0
+
+
+class CondaPlugin(RuntimeEnvPlugin):
+    """Declared-but-gated (reference: _private/runtime_env/conda.py
+    creates/caches conda envs and relaunches the worker inside them).
+    Offline + single-interpreter here: a *named* env matching the
+    currently-active one passes through; anything else raises with the
+    reason."""
+
+    name = "conda"
+    priority = 4
+
+    def validate(self, value, env):
+        if not isinstance(value, (str, dict)):
+            raise TypeError("conda must be an env name or a conda "
+                            "environment.yml dict")
+        if isinstance(value, dict) and "dependencies" not in value:
+            raise ValueError("conda dict spec needs a 'dependencies' key")
+        return value
+
+    def create(self, uri, env):
+        spec = env.get("conda")
+        active = os.environ.get("CONDA_DEFAULT_ENV")
+        if isinstance(spec, str) and spec == active:
+            return None, 0  # already inside the requested env
+        raise RuntimeError(
+            f"conda runtime_env {spec!r} cannot be materialized: this "
+            "deployment runs offline without a conda toolchain "
+            f"(active env: {active or 'none'}). Name the already-active "
+            "env, or use pip with a local wheel dir instead.")
+
+
+class ContainerPlugin(RuntimeEnvPlugin):
+    """Declared-but-gated (reference: _private/runtime_env/container.py
+    wraps the worker command in ``podman run``). Validates the schema;
+    raises unless a container runtime exists on the host."""
+
+    name = "container"
+    priority = 2
+
+    def validate(self, value, env):
+        if not isinstance(value, dict) or "image" not in value:
+            raise ValueError(
+                "container must be {'image': ..., 'run_options': [...]}")
+        unknown = set(value) - {"image", "run_options", "worker_path"}
+        if unknown:
+            raise ValueError(f"unknown container fields {sorted(unknown)}")
+        return dict(value)
+
+    def create(self, uri, env):
+        for runtime in ("podman", "docker"):
+            if shutil.which(runtime):
+                raise RuntimeError(
+                    f"container runtime_env found {runtime!r}, but "
+                    "per-worker container relaunch is not wired into "
+                    "this deployment; run the whole node inside the "
+                    "image instead")
+        raise RuntimeError(
+            "container runtime_env requires podman or docker on the "
+            "host; neither is available in this environment")
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+_PLUGINS: Dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    """Register a custom plugin (reference: plugin.py
+    RuntimeEnvPluginManager.load_plugins / RAY_RUNTIME_ENV_PLUGINS)."""
+    if not plugin.name:
+        raise ValueError("plugin needs a name")
+    _PLUGINS[plugin.name] = plugin
+
+
+for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(),
+           PipPlugin(), CondaPlugin(), ContainerPlugin()):
+    register_plugin(_p)
+
+
+def _load_env_plugins() -> None:
+    """Import plugins named in RT_RUNTIME_ENV_PLUGINS=module:attr,..."""
+    spec = os.environ.get("RT_RUNTIME_ENV_PLUGINS")
+    if not spec:
+        return
+    import importlib
+
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        mod_name, _, attr = item.partition(":")
+        obj = getattr(importlib.import_module(mod_name), attr)
+        register_plugin(obj() if isinstance(obj, type) else obj)
+
+
+_load_env_plugins()
 
 
 def stage_working_dir(source: str, job_id_hex: str) -> str:
@@ -67,50 +449,33 @@ def stage_working_dir(source: str, job_id_hex: str) -> str:
 
 
 def apply_runtime_env(env: Optional[Dict]) -> Dict[str, Any]:
-    """Apply in the worker process before task execution.
-
-    Returns undo info (reference: the runtime-env agent materializes the
-    env before worker start; here workers are generic and apply per-task).
+    """Apply in the worker process before task execution: run every
+    relevant plugin (ascending priority) — create with URI caching, then
+    modify_context — and apply the assembled context. Returns undo info.
     """
     if not env:
         return {}
-    undo: Dict[str, Any] = {}
-    env_vars = env.get("env_vars")
-    if env_vars:
-        undo["env_vars"] = {k: os.environ.get(k) for k in env_vars}
-        os.environ.update(env_vars)
-    working_dir = env.get("working_dir")
-    if working_dir:
-        undo["cwd"] = os.getcwd()
-        os.chdir(working_dir)
-        sys.path.insert(0, working_dir)
-        undo["sys_path_entry"] = working_dir
-    for mod_path in env.get("py_modules", []):
-        parent = (os.path.dirname(mod_path)
-                  if os.path.isfile(mod_path) else mod_path)
-        sys.path.insert(0, parent)
-        undo.setdefault("extra_paths", []).append(parent)
-    pip_pkgs = env.get("pip") or []
-    if pip_pkgs:
-        wheel_dir = env.get("pip_wheel_dir") or os.environ.get(
-            "RT_RUNTIME_ENV_WHEEL_DIR")
-        if wheel_dir:
-            site = materialize_pip_env(pip_pkgs, wheel_dir)
-            sys.path.insert(0, site)
-            undo.setdefault("extra_paths", []).append(site)
-        else:
-            # NETWORK installs are forbidden here: without a local wheel
-            # dir the packages must already import.
-            for pkg in pip_pkgs:
-                name = pkg.split("==")[0].split(">=")[0].replace("-", "_")
-                try:
-                    __import__(name)
-                except ImportError as e:
-                    raise RuntimeError(
-                        f"runtime_env pip package {pkg!r} unavailable; "
-                        f"installs are disabled — provide pip_wheel_dir "
-                        f"(or RT_RUNTIME_ENV_WHEEL_DIR) with local wheels"
-                    ) from e
+    ctx = RuntimeEnvContext()
+    pinned: List[str] = []
+    for plugin in sorted(_PLUGINS.values(), key=lambda p: p.priority):
+        if plugin.name not in env:
+            continue
+        uri = plugin.get_uri(env)
+        hit = (uri is not None and _URI_CACHE.mark_used(uri)
+               and plugin.check_uri(uri))
+        if not hit:
+            _path, nbytes = plugin.create(uri, env)
+            if uri is not None and nbytes:
+                _URI_CACHE.add(uri, nbytes, plugin.delete_uri)
+        if uri is not None:
+            # Pin while applied: eviction must not rmtree a site dir a
+            # live task still has on sys.path.
+            _URI_CACHE.pin(uri)
+            pinned.append(uri)
+        plugin.modify_context(uri, env, ctx)
+    undo = ctx.apply()
+    if pinned:
+        undo["pinned_uris"] = pinned
     return undo
 
 
@@ -120,15 +485,10 @@ def materialize_pip_env(pip: List[str], wheel_dir: str) -> str:
     ``pip install --no-index --find-links=<local wheels> --target=<cache>``
     gives the same isolation contract fully OFFLINE). Concurrent workers
     race on a directory lock; the winner installs, the rest reuse."""
-    import hashlib
-    import json as json_mod
     import subprocess
     import time as time_mod
 
-    key = hashlib.sha1(json_mod.dumps(
-        [sorted(pip), os.path.abspath(wheel_dir)]).encode()).hexdigest()[:16]
-    target = os.path.join(tempfile.gettempdir(), "rt_runtime_env", "pip",
-                          key)
+    target = _pip_site(_pip_env_key(pip, wheel_dir))
     marker = os.path.join(target, ".rt_ready")
     if os.path.exists(marker):
         return target
@@ -177,6 +537,8 @@ def materialize_pip_env(pip: List[str], wheel_dir: str) -> str:
 
 
 def restore_runtime_env(undo: Dict[str, Any]) -> None:
+    for uri in undo.get("pinned_uris", []):
+        _URI_CACHE.unpin(uri)
     for k, v in (undo.get("env_vars") or {}).items():
         if v is None:
             os.environ.pop(k, None)
@@ -184,9 +546,6 @@ def restore_runtime_env(undo: Dict[str, Any]) -> None:
             os.environ[k] = v
     if "cwd" in undo:
         os.chdir(undo["cwd"])
-    entry = undo.get("sys_path_entry")
-    if entry and entry in sys.path:
-        sys.path.remove(entry)
     for p in undo.get("extra_paths", []):
         if p in sys.path:
             sys.path.remove(p)

@@ -259,6 +259,10 @@ class PB2(PopulationBasedTraining):
         for k, (lo, hi) in hyperparam_bounds.items():
             if not hi > lo:
                 raise ValueError(f"bad bounds for {k!r}: ({lo}, {hi})")
+            if k in log_scale_keys and lo <= 0:
+                raise ValueError(
+                    f"log-scale key {k!r} needs a positive lower bound, "
+                    f"got {lo}")
         self.bounds = dict(hyperparam_bounds)
         self.log_keys = set(log_scale_keys)
         self.ucb_coeff = ucb_coeff

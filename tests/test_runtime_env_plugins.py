@@ -1,0 +1,194 @@
+"""Runtime-env plugin architecture tests
+(reference: _private/runtime_env tests for plugin.py, uri_cache.py,
+conda.py, container.py)."""
+
+import os
+
+import pytest
+
+from ray_tpu.runtime_env import (
+    RuntimeEnv,
+    RuntimeEnvContext,
+    RuntimeEnvPlugin,
+    URICache,
+    apply_runtime_env,
+    register_plugin,
+    restore_runtime_env,
+    _PLUGINS,
+)
+
+
+def test_validation_routes_through_plugins(tmp_path):
+    (tmp_path / "wd").mkdir()
+    env = RuntimeEnv(env_vars={"A": "1"}, working_dir=str(tmp_path / "wd"))
+    assert env["env_vars"] == {"A": "1"}
+    assert env["working_dir"] == str(tmp_path / "wd")
+    with pytest.raises(TypeError, match="env_vars"):
+        RuntimeEnv(env_vars={"A": 1})
+    with pytest.raises(ValueError, match="unknown runtime_env fields"):
+        RuntimeEnv(bogus_field=1)
+
+
+def test_custom_plugin_full_lifecycle(tmp_path):
+    calls = []
+
+    class TokenPlugin(RuntimeEnvPlugin):
+        name = "token"
+        priority = 3
+
+        def validate(self, value, env):
+            if not isinstance(value, str):
+                raise TypeError("token must be str")
+            return value
+
+        def get_uri(self, env):
+            return f"token://{env['token']}"
+
+        def create(self, uri, env):
+            calls.append(("create", uri))
+            return None, 1
+
+        def modify_context(self, uri, env, ctx):
+            calls.append(("modify", uri))
+            ctx.env_vars["RT_TOKEN"] = env["token"]
+
+    register_plugin(TokenPlugin())
+    try:
+        env = RuntimeEnv(token="sekrit")
+        undo = apply_runtime_env(env)
+        assert os.environ.get("RT_TOKEN") == "sekrit"
+        restore_runtime_env(undo)
+        assert os.environ.get("RT_TOKEN") is None
+        # Second apply hits the URI cache: no second create.
+        undo = apply_runtime_env(env)
+        restore_runtime_env(undo)
+        creates = [c for c in calls if c[0] == "create"]
+        modifies = [c for c in calls if c[0] == "modify"]
+        assert len(creates) == 1
+        assert len(modifies) == 2
+    finally:
+        _PLUGINS.pop("token", None)
+
+
+def test_plugin_priority_ordering(tmp_path):
+    order = []
+
+    class A(RuntimeEnvPlugin):
+        name = "aaa"
+        priority = 9
+
+        def modify_context(self, uri, env, ctx):
+            order.append("aaa")
+
+    class B(RuntimeEnvPlugin):
+        name = "bbb"
+        priority = 2
+
+        def modify_context(self, uri, env, ctx):
+            order.append("bbb")
+
+    register_plugin(A())
+    register_plugin(B())
+    try:
+        undo = apply_runtime_env({"aaa": 1, "bbb": 1})
+        restore_runtime_env(undo)
+        assert order == ["bbb", "aaa"]
+    finally:
+        _PLUGINS.pop("aaa", None)
+        _PLUGINS.pop("bbb", None)
+
+
+def test_uri_cache_lru_eviction():
+    deleted = []
+    cache = URICache(max_total_bytes=100)
+    cache.add("u1", 40, lambda u: deleted.append(u) or 40)
+    cache.add("u2", 40, lambda u: deleted.append(u) or 40)
+    assert cache.mark_used("u1")  # u1 now MRU
+    cache.add("u3", 40, lambda u: deleted.append(u) or 40)
+    # 120 > 100: evict LRU = u2 (u1 was refreshed).
+    assert deleted == ["u2"]
+    assert cache.mark_used("u1") and cache.mark_used("u3")
+    assert not cache.mark_used("u2")
+
+
+def test_sys_path_precedence_later_plugins_win(tmp_path):
+    """pip site > py_modules > working_dir on sys.path: a pinned pip
+    version must shadow a stale copy in the working dir."""
+    import sys
+
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    pm = tmp_path / "mods"
+    pm.mkdir()
+    undo = apply_runtime_env({"working_dir": str(wd),
+                              "py_modules": [str(pm)]})
+    try:
+        assert sys.path.index(str(pm)) < sys.path.index(str(wd))
+    finally:
+        restore_runtime_env(undo)
+
+
+def test_uri_cache_pinned_entries_survive_eviction():
+    deleted = []
+    cache = URICache(max_total_bytes=100)
+    cache.add("u1", 80, lambda u: deleted.append(u) or 80)
+    cache.pin("u1")
+    cache.add("u2", 80, lambda u: deleted.append(u) or 80)
+    # Over budget, but u1 is pinned (in use): only unpinned entries go.
+    assert "u1" not in deleted
+    cache.unpin("u1")
+    cache.add("u3", 80, lambda u: deleted.append(u) or 80)
+    assert "u1" in deleted
+
+
+def test_conda_gating():
+    env = RuntimeEnv(conda="some-env-that-is-not-active")
+    with pytest.raises(RuntimeError, match="offline"):
+        apply_runtime_env(env)
+    with pytest.raises(ValueError, match="dependencies"):
+        RuntimeEnv(conda={"name": "x"})
+    # Naming the active env (if any) is a no-op pass-through.
+    active = os.environ.get("CONDA_DEFAULT_ENV")
+    if active:
+        restore_runtime_env(apply_runtime_env(RuntimeEnv(conda=active)))
+
+
+def test_container_gating():
+    with pytest.raises(ValueError, match="image"):
+        RuntimeEnv(container={"run_options": []})
+    env = RuntimeEnv(container={"image": "repo/img:tag"})
+    with pytest.raises(RuntimeError,
+                       match="podman|docker|container runtime"):
+        apply_runtime_env(env)
+
+
+def test_env_var_plugin_loading(tmp_path, monkeypatch):
+    mod = tmp_path / "my_plugmod.py"
+    mod.write_text(
+        "from ray_tpu.runtime_env import RuntimeEnvPlugin\n"
+        "class MyPlugin(RuntimeEnvPlugin):\n"
+        "    name = 'myext'\n"
+        "    def modify_context(self, uri, env, ctx):\n"
+        "        ctx.env_vars['MYEXT'] = str(env['myext'])\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    monkeypatch.setenv("RT_RUNTIME_ENV_PLUGINS", "my_plugmod:MyPlugin")
+    from ray_tpu.runtime_env import _load_env_plugins
+
+    _load_env_plugins()
+    try:
+        undo = apply_runtime_env({"myext": 7})
+        assert os.environ.get("MYEXT") == "7"
+        restore_runtime_env(undo)
+    finally:
+        _PLUGINS.pop("myext", None)
+
+
+def test_worker_applies_runtime_env_end_to_end(rt_shared, tmp_path):
+    """The whole plugin chain runs inside a real worker process."""
+    import ray_tpu as rt
+
+    @rt.remote(runtime_env={"env_vars": {"RT_PLUGIN_E2E": "yes"}})
+    def probe():
+        return os.environ.get("RT_PLUGIN_E2E")
+
+    assert rt.get(probe.remote()) == "yes"
