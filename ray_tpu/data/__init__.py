@@ -29,6 +29,18 @@ from .datasource import (
     read_numpy,
     read_parquet,
     read_tfrecords,
+    write_partitioned,
+)
+from .partitioning import (
+    DefaultFileMetadataProvider,
+    FastFileMetadataProvider,
+    FileMetadata,
+    FileMetadataProvider,
+    Partitioning,
+    PartitionStyle,
+    PathPartitionEncoder,
+    PathPartitionFilter,
+    PathPartitionParser,
 )
 from .random_access import RandomAccessDataset
 from .pipeline import DatasetPipeline
@@ -36,11 +48,15 @@ from .stats import DatasetStats
 
 __all__ = [
     "BinaryDatasource", "Block", "BlockAccessor", "CSVDatasource", "Dataset",
-    "DatasetPipeline", "DatasetStats", "Datasource", "GroupedData",
+    "DatasetPipeline", "DatasetStats", "Datasource",
+    "DefaultFileMetadataProvider", "FastFileMetadataProvider",
+    "FileMetadata", "FileMetadataProvider", "GroupedData",
     "ImageFolderDatasource", "JSONDatasource",
-    "NumpyDatasource", "ParquetDatasource", "RandomAccessDataset",
+    "NumpyDatasource", "ParquetDatasource", "PartitionStyle",
+    "Partitioning", "PathPartitionEncoder", "PathPartitionFilter",
+    "PathPartitionParser", "RandomAccessDataset",
     "TFRecordDatasource", "from_items", "from_numpy",
     "from_pandas", "range", "read_binary_files", "read_csv",
     "read_datasource", "read_images", "read_json", "read_numpy",
-    "read_parquet", "read_tfrecords",
+    "read_parquet", "read_tfrecords", "write_partitioned",
 ]

@@ -19,10 +19,25 @@ import numpy as np
 from ..core import get, put, remote
 from .block import BlockAccessor
 from .dataset import Dataset, from_items
+from .partitioning import (
+    DefaultFileMetadataProvider,
+    FileMetadataProvider,
+    PartitionStyle,
+    Partitioning,
+    PathPartitionEncoder,
+    PathPartitionFilter,
+    PathPartitionParser,
+    attach_partition_columns,
+)
 
 
 class Datasource:
     """Subclass and implement read_task_args/read_file + write_block."""
+
+    #: Extensions kept by recursive partitioned walks (None = keep all).
+    #: Hive trees routinely carry _SUCCESS markers / READMEs that would
+    #: otherwise crash format parsers.
+    FILE_EXTENSIONS: Optional[tuple] = None
 
     def expand_paths(self, paths) -> List[str]:
         if isinstance(paths, str):
@@ -48,15 +63,46 @@ class Datasource:
     def write_block(self, block, path: str) -> None:
         raise NotImplementedError
 
-    def read(self, paths, parallelism: int = 8) -> Dataset:
-        files = self.expand_paths(paths)
+    def _resolve_paths(self, paths,
+                      partitioning: Optional[Partitioning],
+                      partition_filter: Optional[PathPartitionFilter],
+                      meta_provider: Optional[FileMetadataProvider]):
+        """Expand + prune the file list. Partitioned layouts walk
+        recursively through the metadata provider; partition filters
+        prune paths BEFORE any file IO (reference: path_partition_filter
+        in file_based_datasource.py)."""
+        if (partitioning is None and partition_filter is None
+                and meta_provider is None):
+            return self.expand_paths(paths)  # legacy flat listing
+        mp = meta_provider or DefaultFileMetadataProvider()
+        if mp.file_extensions is None and self.FILE_EXTENSIONS:
+            mp.file_extensions = self.FILE_EXTENSIONS
+        files = mp.expand_paths(paths)
+        if partition_filter is not None:
+            files = partition_filter(files)
+        return files
+
+    def read(self, paths, parallelism: int = 8,
+             partitioning: Optional[Partitioning] = None,
+             partition_filter: Optional[PathPartitionFilter] = None,
+             meta_provider: Optional[FileMetadataProvider] = None
+             ) -> Dataset:
+        files = self._resolve_paths(paths, partitioning,
+                                    partition_filter, meta_provider)
+        parser = (PathPartitionParser(partitioning)
+                  if partitioning else None)
         reader = remote(self.__class__._read_task)
-        refs = [reader.remote(self.__class__, f) for f in files]
+        refs = [reader.remote(self.__class__, f,
+                              parser(f) if parser else None)
+                for f in files]
         return Dataset(refs)
 
     @staticmethod
-    def _read_task(cls, path):
-        return cls().read_file(path)
+    def _read_task(cls, path, partition_values=None):
+        rows = cls().read_file(path)
+        if partition_values:
+            rows = attach_partition_columns(rows, partition_values)
+        return rows
 
     def write(self, ds: Dataset, path: str, prefix: str = "part") -> List[str]:
         os.makedirs(path, exist_ok=True)
@@ -85,6 +131,7 @@ class Datasource:
 
 class CSVDatasource(Datasource):
     EXT = "csv"
+    FILE_EXTENSIONS = (".csv",)
 
     def read_file(self, path: str):
         with open(path, newline="") as f:
@@ -115,6 +162,7 @@ class CSVDatasource(Datasource):
 
 class JSONDatasource(Datasource):
     EXT = "json"
+    FILE_EXTENSIONS = (".json", ".jsonl")
 
     def read_file(self, path: str):
         rows = []
@@ -134,6 +182,7 @@ class JSONDatasource(Datasource):
 
 class NumpyDatasource(Datasource):
     EXT = "npy"
+    FILE_EXTENSIONS = (".npy", ".npz")
 
     def read_file(self, path: str):
         arr = np.load(path, allow_pickle=False)
@@ -149,6 +198,7 @@ class NumpyDatasource(Datasource):
 
 class ParquetDatasource(Datasource):
     EXT = "parquet"
+    FILE_EXTENSIONS = (".parquet", ".pq")
 
     def read_file(self, path: str):
         try:
@@ -159,7 +209,11 @@ class ParquetDatasource(Datasource):
             ) from e
         return pq.read_table(path).to_pandas()
 
-    def read(self, paths, parallelism: int = 8) -> Dataset:
+    def read(self, paths, parallelism: int = 8,
+             partitioning: Optional[Partitioning] = None,
+             partition_filter: Optional[PathPartitionFilter] = None,
+             meta_provider: Optional[FileMetadataProvider] = None
+             ) -> Dataset:
         """Row-group parallel reads: one task per parquet ROW GROUP (not
         per file), so a single large file still fans out (reference:
         ParquetDatasource row-group splitting, data/datasource/
@@ -168,20 +222,30 @@ class ParquetDatasource(Datasource):
         try:
             import pyarrow.parquet as pq
         except ImportError:
-            return super().read(paths, parallelism)
-        files = self.expand_paths(paths)
+            return super().read(paths, parallelism, partitioning,
+                                partition_filter, meta_provider)
+        files = self._resolve_paths(paths, partitioning,
+                                    partition_filter, meta_provider)
+        parser = (PathPartitionParser(partitioning)
+                  if partitioning else None)
         reader = remote(ParquetDatasource._read_row_group_task)
         refs = []
         for f in files:
+            pvals = parser(f) if parser else None
             n_groups = pq.ParquetFile(f).metadata.num_row_groups
-            refs.extend(reader.remote(f, g) for g in range(n_groups))
+            refs.extend(reader.remote(f, g, pvals)
+                        for g in range(n_groups))
         return Dataset(refs)
 
     @staticmethod
-    def _read_row_group_task(path: str, group: int):
+    def _read_row_group_task(path: str, group: int,
+                             partition_values=None):
         import pyarrow.parquet as pq
 
-        return pq.ParquetFile(path).read_row_group(group).to_pandas()
+        df = pq.ParquetFile(path).read_row_group(group).to_pandas()
+        if partition_values:
+            df = attach_partition_columns(df, partition_values)
+        return df
 
     def write_block(self, block, path: str) -> None:
         try:
@@ -300,6 +364,7 @@ class TFRecordDatasource(Datasource):
     dependency."""
 
     EXT = "tfrecord"
+    FILE_EXTENSIONS = (".tfrecord", ".tfrecords")
 
     def read_file(self, path: str):
         import struct
@@ -351,35 +416,82 @@ def _jsonable(row):
     return row
 
 
+def write_partitioned(ds: Dataset, source: Datasource, base_dir: str,
+                      partition_cols: List[str],
+                      style: PartitionStyle = PartitionStyle.HIVE
+                      ) -> List[str]:
+    """Write a Dataset as a partition-keyed directory tree
+    (``base/col1=v1/col2=v2/part-....ext``; reference: the
+    ``partition_cols`` path of ``Dataset.write_parquet`` /
+    ``PathPartitionEncoder``). One task per block; each task splits its
+    rows by partition-value tuple and writes one file per group, so the
+    layout emerges without any driver-side shuffle."""
+    encoder = PathPartitionEncoder(
+        Partitioning(style, base_dir, tuple(partition_cols)))
+    writer = remote(_write_partitioned_task)
+    ext = getattr(source, "EXT", "dat")
+    written = get([
+        writer.remote(type(source), ref, base_dir, list(partition_cols),
+                      encoder, f"part-{i:05d}", ext)
+        for i, ref in enumerate(ds._blocks)
+    ])
+    return [p for sub in written for p in sub]
+
+
+def _write_partitioned_task(source_cls, block, base_dir: str,
+                            cols: List[str], encoder, stem: str,
+                            ext: str) -> List[str]:
+    rows = BlockAccessor.for_block(block).to_rows()
+    groups: Dict[tuple, list] = {}
+    for r in rows:
+        if not isinstance(r, dict) or any(c not in r for c in cols):
+            raise ValueError(
+                f"write_partitioned needs dict rows containing "
+                f"partition cols {cols}")
+        groups.setdefault(tuple(r[c] for c in cols), []).append(
+            {k: v for k, v in r.items() if k not in cols})
+    out = []
+    src = source_cls()
+    for values, grows in sorted(groups.items(), key=lambda kv: str(kv[0])):
+        rel = encoder(dict(zip(cols, values)))
+        d = os.path.join(base_dir, rel)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"{stem}.{ext}")
+        result = src.write_block(grows, path)
+        out.extend(result if isinstance(result, list) else [path])
+    return out
+
+
 # -- read/write API (reference: data/read_api.py surface) --------------------
 
-def read_csv(paths, parallelism: int = 8) -> Dataset:
-    return CSVDatasource().read(paths, parallelism)
+def read_csv(paths, parallelism: int = 8, **kwargs) -> Dataset:
+    return CSVDatasource().read(paths, parallelism, **kwargs)
 
 
-def read_json(paths, parallelism: int = 8) -> Dataset:
-    return JSONDatasource().read(paths, parallelism)
+def read_json(paths, parallelism: int = 8, **kwargs) -> Dataset:
+    return JSONDatasource().read(paths, parallelism, **kwargs)
 
 
-def read_numpy(paths, parallelism: int = 8) -> Dataset:
-    return NumpyDatasource().read(paths, parallelism)
+def read_numpy(paths, parallelism: int = 8, **kwargs) -> Dataset:
+    return NumpyDatasource().read(paths, parallelism, **kwargs)
 
 
-def read_parquet(paths, parallelism: int = 8) -> Dataset:
-    return ParquetDatasource().read(paths, parallelism)
+def read_parquet(paths, parallelism: int = 8, **kwargs) -> Dataset:
+    return ParquetDatasource().read(paths, parallelism, **kwargs)
 
 
-def read_binary_files(paths, parallelism: int = 8) -> Dataset:
-    return BinaryDatasource().read(paths, parallelism)
+def read_binary_files(paths, parallelism: int = 8, **kwargs) -> Dataset:
+    return BinaryDatasource().read(paths, parallelism, **kwargs)
 
 
-def read_images(paths, parallelism: int = 8) -> Dataset:
-    return ImageFolderDatasource().read(paths, parallelism)
+def read_images(paths, parallelism: int = 8, **kwargs) -> Dataset:
+    return ImageFolderDatasource().read(paths, parallelism, **kwargs)
 
 
-def read_tfrecords(paths, parallelism: int = 8) -> Dataset:
-    return TFRecordDatasource().read(paths, parallelism)
+def read_tfrecords(paths, parallelism: int = 8, **kwargs) -> Dataset:
+    return TFRecordDatasource().read(paths, parallelism, **kwargs)
 
 
-def read_datasource(source: Datasource, paths, parallelism: int = 8) -> Dataset:
-    return source.read(paths, parallelism)
+def read_datasource(source: Datasource, paths, parallelism: int = 8,
+                    **kwargs) -> Dataset:
+    return source.read(paths, parallelism, **kwargs)

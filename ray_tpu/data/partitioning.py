@@ -1,0 +1,291 @@
+"""Partitioned file layouts + pluggable file metadata providers.
+
+Reference analogs:
+- ``python/ray/data/datasource/partitioning.py`` — ``PartitionStyle``
+  (:19), ``Partitioning`` (:40), ``PathPartitionEncoder`` (:107),
+  ``PathPartitionParser`` (:224), ``PathPartitionFilter`` (:393).
+- ``python/ray/data/datasource/file_meta_provider.py`` —
+  ``FileMetadataProvider`` (:22), ``DefaultFileMetadataProvider``
+  (:125), ``FastFileMetadataProvider`` (:189).
+
+Hive-style layouts (``base/year=2024/month=07/f.csv``) and directory
+layouts (``base/2024/07/f.csv`` with declared field names) both parse to
+``{field: value}`` dicts; readers attach those as columns, push partition
+filters down to path pruning (skipping whole subtrees before any file
+IO), and writers emit partition-keyed directory trees.
+"""
+
+from __future__ import annotations
+
+import os
+import posixpath
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class PartitionStyle(str, Enum):
+    """Reference: partitioning.py:19."""
+
+    HIVE = "hive"          # key1=val1/key2=val2/...
+    DIRECTORY = "dir"      # val1/val2/... with declared field_names
+
+
+@dataclass(frozen=True)
+class Partitioning:
+    """Declarative partition scheme (reference: partitioning.py:40)."""
+
+    style: PartitionStyle = PartitionStyle.HIVE
+    base_dir: str = ""
+    field_names: Optional[Tuple[str, ...]] = None
+
+    def __post_init__(self):
+        if self.style == PartitionStyle.DIRECTORY and not self.field_names:
+            raise ValueError(
+                "DIRECTORY partitioning requires field_names (dir "
+                "levels carry no key names)")
+        if self.field_names is not None:
+            object.__setattr__(self, "field_names",
+                               tuple(self.field_names))
+
+    @property
+    def normalized_base_dir(self) -> str:
+        base = self.base_dir or ""
+        if base and not base.endswith("/"):
+            base += "/"
+        return base
+
+
+class PathPartitionEncoder:
+    """partition values -> relative directory path
+    (reference: partitioning.py:107)."""
+
+    def __init__(self, partitioning: Partitioning):
+        self.scheme = partitioning
+
+    def __call__(self, values: Dict[str, Any]) -> str:
+        if self.scheme.style == PartitionStyle.HIVE:
+            names = (self.scheme.field_names
+                     or tuple(sorted(values)))
+            parts = [f"{n}={values[n]}" for n in names]
+        else:
+            parts = [str(values[n]) for n in self.scheme.field_names]
+        return posixpath.join(*parts) if parts else ""
+
+
+class PathPartitionParser:
+    """file path -> {field: value} (reference: partitioning.py:224).
+
+    Returns {} for unpartitioned paths; raises on DIRECTORY paths whose
+    depth under base_dir does not match field_names.
+    """
+
+    def __init__(self, partitioning: Partitioning):
+        self.scheme = partitioning
+
+    def _relative_dir(self, path: str) -> Optional[str]:
+        base = self.scheme.normalized_base_dir
+        norm = path.replace(os.sep, "/")
+        if base:
+            nbase = base.replace(os.sep, "/")
+            if not norm.startswith(nbase):
+                return None
+            norm = norm[len(nbase):]
+        return posixpath.dirname(norm)
+
+    def __call__(self, path: str) -> Dict[str, str]:
+        rel = self._relative_dir(path)
+        if rel is None:
+            return {}
+        segments = [s for s in rel.split("/") if s]
+        if self.scheme.style == PartitionStyle.HIVE:
+            out: Dict[str, str] = {}
+            for seg in segments:
+                if "=" in seg:
+                    k, _, v = seg.partition("=")
+                    out[k] = v
+            return out
+        names = self.scheme.field_names or ()
+        # Directory style needs the full declared depth; partial paths
+        # are ambiguous.
+        segments = segments[-len(names):] if len(segments) >= len(
+            names) else segments
+        if len(segments) != len(names):
+            raise ValueError(
+                f"path {path!r} has {len(segments)} partition levels "
+                f"under {self.scheme.base_dir!r}; expected "
+                f"{len(names)} ({names})")
+        return dict(zip(names, segments))
+
+
+class PathPartitionFilter:
+    """Prune paths by their parsed partition values
+    (reference: partitioning.py:393). ``filter_fn`` receives the
+    ``{field: value}`` dict and returns keep/drop."""
+
+    def __init__(self, partitioning: Partitioning,
+                 filter_fn: Callable[[Dict[str, str]], bool]):
+        self.parser = PathPartitionParser(partitioning)
+        self.filter_fn = filter_fn
+
+    @staticmethod
+    def of(filter_fn: Callable[[Dict[str, str]], bool],
+           style: PartitionStyle = PartitionStyle.HIVE,
+           base_dir: str = "",
+           field_names: Optional[Tuple[str, ...]] = None
+           ) -> "PathPartitionFilter":
+        return PathPartitionFilter(
+            Partitioning(style, base_dir, field_names), filter_fn)
+
+    def __call__(self, paths: List[str]) -> List[str]:
+        return [p for p in paths if self.filter_fn(self.parser(p))]
+
+
+# ---------------------------------------------------------------------------
+# File metadata providers
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FileMetadata:
+    """Per-file facts a reader can plan with (reference:
+    BlockMetadata in file_meta_provider._get_block_metadata)."""
+
+    path: str
+    size_bytes: Optional[int] = None
+    partition_values: Dict[str, str] = field(default_factory=dict)
+
+
+class FileMetadataProvider:
+    """Expands read paths and supplies per-file metadata
+    (reference: file_meta_provider.py:22)."""
+
+    #: extensions this expansion keeps (None = keep everything)
+    file_extensions: Optional[Tuple[str, ...]] = None
+
+    def expand_paths(self, paths, *, recursive: bool = True) -> List[str]:
+        raise NotImplementedError
+
+    def get_metadata(self, path: str) -> FileMetadata:
+        raise NotImplementedError
+
+
+class DefaultFileMetadataProvider(FileMetadataProvider):
+    """Walks directories recursively, checks existence, stats sizes
+    (reference: file_meta_provider.py:125)."""
+
+    def expand_paths(self, paths, *, recursive: bool = True) -> List[str]:
+        import glob as _glob
+
+        if isinstance(paths, str):
+            paths = [paths]
+        out: List[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                if recursive:
+                    for dirpath, dirs, files in sorted(os.walk(p)):
+                        dirs.sort()
+                        out.extend(sorted(
+                            os.path.join(dirpath, f) for f in files
+                            if not f.startswith(".")))
+                else:
+                    out.extend(sorted(
+                        os.path.join(p, f) for f in os.listdir(p)
+                        if not f.startswith(".")))
+            elif any(c in p for c in "*?["):
+                out.extend(sorted(_glob.glob(p)))
+            elif os.path.exists(p):
+                out.append(p)
+            else:
+                raise FileNotFoundError(p)
+        if self.file_extensions:
+            out = [p for p in out
+                   if p.lower().endswith(self.file_extensions)]
+        if not out:
+            raise FileNotFoundError(f"no files matched {paths}")
+        return out
+
+    def get_metadata(self, path: str) -> FileMetadata:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = None
+        return FileMetadata(path, size)
+
+
+class FastFileMetadataProvider(DefaultFileMetadataProvider):
+    """Skips per-file stat/existence checks — trade safety for listing
+    speed on huge path lists (reference: file_meta_provider.py:189,
+    which warns exactly this tradeoff)."""
+
+    def expand_paths(self, paths, *, recursive: bool = True) -> List[str]:
+        import glob as _glob
+
+        if isinstance(paths, str):
+            paths = [paths]
+        out: List[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                # Directory walks are unavoidable; files pass unstated.
+                out.extend(super().expand_paths([p], recursive=recursive))
+            elif any(c in p for c in "*?["):
+                out.extend(sorted(_glob.glob(p)))
+            else:
+                out.append(p)  # no existence check
+        if not out:
+            raise FileNotFoundError(f"no files matched {paths}")
+        return out
+
+    def get_metadata(self, path: str) -> FileMetadata:
+        return FileMetadata(path, None)
+
+
+def attach_partition_columns(rows: Any,
+                             values: Dict[str, str]) -> Any:
+    """Merge parsed partition values into a block's rows as columns
+    (reference: file-based datasources add partition cols to each
+    block). Values never overwrite real columns of the same name.
+    Dict-rows and pandas blocks get columns; opaque rows pass through.
+    """
+    if not values:
+        return rows
+    coerced = {k: _coerce(v) for k, v in values.items()}
+    try:
+        import pandas as pd
+
+        if isinstance(rows, pd.DataFrame):
+            for k, v in coerced.items():
+                if k not in rows.columns:
+                    rows[k] = v
+            return rows
+    except ImportError:
+        pass
+    if isinstance(rows, list):
+        for r in rows:
+            if isinstance(r, dict):
+                for k, v in coerced.items():
+                    r.setdefault(k, v)
+        return rows
+    if isinstance(rows, dict) and rows:
+        # Columnar block (e.g. numpy datasource: {"data": arr}):
+        # broadcast each partition value to a full column.
+        import numpy as _np
+
+        n = len(next(iter(rows.values())))
+        for k, v in coerced.items():
+            if k not in rows:
+                rows[k] = _np.full(n, v)
+        return rows
+    return rows
+
+
+def _coerce(v: str) -> Any:
+    """Partition path segments are strings; int/float-looking ones come
+    back typed (hive readers do the same inference)."""
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return v

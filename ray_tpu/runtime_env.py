@@ -118,43 +118,55 @@ class URICache:
     (reference: _private/runtime_env/uri_cache.py)."""
 
     def __init__(self, max_total_bytes: int = 2 * 1024 ** 3):
+        import threading
+
         self.max_total_bytes = max_total_bytes
         self._entries: Dict[str, int] = {}  # uri -> bytes (LRU order)
         self._deleters: Dict[str, Callable[[str], int]] = {}
         self._pins: Dict[str, int] = {}  # uri -> refcount
+        # Concurrent actor executor threads apply runtime envs in the
+        # same process; every mutation must hold this.
+        self._lock = threading.RLock()
 
     @property
     def total_bytes(self) -> int:
-        return sum(self._entries.values())
+        with self._lock:
+            return sum(self._entries.values())
 
     def mark_used(self, uri: str) -> bool:
-        if uri in self._entries:
-            self._entries[uri] = self._entries.pop(uri)  # move to MRU
-            return True
-        return False
+        with self._lock:
+            if uri in self._entries:
+                self._entries[uri] = self._entries.pop(uri)  # -> MRU
+                return True
+            return False
 
     def pin(self, uri: str) -> None:
         """A pinned URI is in use by an applied env; never evicted
-        (reference: uri_cache marks added URIs 'in use')."""
-        self._pins[uri] = self._pins.get(uri, 0) + 1
+        (reference: uri_cache marks added URIs 'in use'). Pin BEFORE
+        add/mark_used so no eviction window exists."""
+        with self._lock:
+            self._pins[uri] = self._pins.get(uri, 0) + 1
 
     def unpin(self, uri: str) -> None:
-        n = self._pins.get(uri, 0) - 1
-        if n <= 0:
-            self._pins.pop(uri, None)
-        else:
-            self._pins[uri] = n
+        with self._lock:
+            n = self._pins.get(uri, 0) - 1
+            if n <= 0:
+                self._pins.pop(uri, None)
+            else:
+                self._pins[uri] = n
 
     def add(self, uri: str, nbytes: int,
             deleter: Callable[[str], int]) -> None:
-        self._entries.pop(uri, None)
-        self._entries[uri] = nbytes
-        self._deleters[uri] = deleter
-        self._evict()
+        with self._lock:
+            self._entries.pop(uri, None)
+            self._entries[uri] = nbytes
+            self._deleters[uri] = deleter
+            self._evict()
 
     def _evict(self) -> None:
+        # Caller holds the lock.
         candidates = [u for u in self._entries if u not in self._pins]
-        while self.total_bytes > self.max_total_bytes and len(
+        while sum(self._entries.values()) > self.max_total_bytes and len(
                 candidates) > 0 and len(self._entries) > 1:
             uri = candidates.pop(0)  # least recently used, unpinned
             self._entries.pop(uri)
@@ -457,22 +469,31 @@ def apply_runtime_env(env: Optional[Dict]) -> Dict[str, Any]:
         return {}
     ctx = RuntimeEnvContext()
     pinned: List[str] = []
-    for plugin in sorted(_PLUGINS.values(), key=lambda p: p.priority):
-        if plugin.name not in env:
-            continue
-        uri = plugin.get_uri(env)
-        hit = (uri is not None and _URI_CACHE.mark_used(uri)
-               and plugin.check_uri(uri))
-        if not hit:
-            _path, nbytes = plugin.create(uri, env)
-            if uri is not None and nbytes:
-                _URI_CACHE.add(uri, nbytes, plugin.delete_uri)
-        if uri is not None:
-            # Pin while applied: eviction must not rmtree a site dir a
-            # live task still has on sys.path.
-            _URI_CACHE.pin(uri)
-            pinned.append(uri)
-        plugin.modify_context(uri, env, ctx)
+    try:
+        for plugin in sorted(_PLUGINS.values(), key=lambda p: p.priority):
+            if plugin.name not in env:
+                continue
+            uri = plugin.get_uri(env)
+            if uri is not None:
+                # Pin FIRST (before add/mark_used): eviction must never
+                # see this URI unpinned — not even in the window before
+                # its own add() (whose _evict would otherwise delete the
+                # just-created resource when everything else is pinned).
+                _URI_CACHE.pin(uri)
+                pinned.append(uri)
+            hit = (uri is not None and _URI_CACHE.mark_used(uri)
+                   and plugin.check_uri(uri))
+            if not hit:
+                _path, nbytes = plugin.create(uri, env)
+                if uri is not None and nbytes:
+                    _URI_CACHE.add(uri, nbytes, plugin.delete_uri)
+            plugin.modify_context(uri, env, ctx)
+    except Exception:
+        # A later plugin failed: release pins taken so far — the caller
+        # never receives undo info, so restore_runtime_env can't.
+        for uri in pinned:
+            _URI_CACHE.unpin(uri)
+        raise
     undo = ctx.apply()
     if pinned:
         undo["pinned_uris"] = pinned

@@ -1,0 +1,151 @@
+"""Partitioned layouts + metadata provider tests
+(reference: python/ray/data/tests/test_partitioning.py)."""
+
+import csv
+import os
+
+import pytest
+
+from ray_tpu.data import (
+    FastFileMetadataProvider,
+    Partitioning,
+    PartitionStyle,
+    PathPartitionEncoder,
+    PathPartitionFilter,
+    PathPartitionParser,
+    read_csv,
+    write_partitioned,
+    from_items,
+    CSVDatasource,
+    JSONDatasource,
+)
+
+
+def _write_csv(path, rows):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=list(rows[0]))
+        w.writeheader()
+        for r in rows:
+            w.writerow(r)
+
+
+def _make_hive_tree(base):
+    for year, month, vals in [(2023, 1, [1, 2]), (2023, 2, [3]),
+                              (2024, 1, [4, 5, 6])]:
+        _write_csv(
+            os.path.join(base, f"year={year}", f"month={month}",
+                         "data.csv"),
+            [{"v": v} for v in vals])
+
+
+def test_hive_parser_and_encoder(tmp_path):
+    scheme = Partitioning(PartitionStyle.HIVE, str(tmp_path))
+    parser = PathPartitionParser(scheme)
+    p = str(tmp_path / "year=2024" / "month=07" / "f.csv")
+    assert parser(p) == {"year": "2024", "month": "07"}
+    enc = PathPartitionEncoder(
+        Partitioning(PartitionStyle.HIVE, "", ("year", "month")))
+    assert enc({"year": 2024, "month": 7}) == "year=2024/month=7"
+
+
+def test_directory_parser_depth_checked(tmp_path):
+    scheme = Partitioning(PartitionStyle.DIRECTORY, str(tmp_path),
+                          ("year", "month"))
+    parser = PathPartitionParser(scheme)
+    assert parser(str(tmp_path / "2024" / "07" / "f.csv")) == \
+        {"year": "2024", "month": "07"}
+    with pytest.raises(ValueError, match="partition levels"):
+        parser(str(tmp_path / "2024" / "f.csv"))
+    with pytest.raises(ValueError, match="field_names"):
+        Partitioning(PartitionStyle.DIRECTORY, str(tmp_path))
+
+
+def test_read_attaches_partition_columns(rt_shared, tmp_path):
+    base = str(tmp_path / "tree")
+    _make_hive_tree(base)
+    ds = read_csv(base, partitioning=Partitioning(
+        PartitionStyle.HIVE, base))
+    rows = sorted(ds.take_all(), key=lambda r: r["v"])
+    assert len(rows) == 6
+    # Partition values arrive as typed columns.
+    assert rows[0] == {"v": 1, "year": 2023, "month": 1}
+    assert rows[5] == {"v": 6, "year": 2024, "month": 1}
+
+
+def test_partition_filter_prunes_before_read(rt_shared, tmp_path):
+    base = str(tmp_path / "tree")
+    _make_hive_tree(base)
+    flt = PathPartitionFilter.of(
+        lambda d: d.get("year") == "2024", base_dir=base)
+    ds = read_csv(base, partitioning=Partitioning(
+        PartitionStyle.HIVE, base), partition_filter=flt)
+    rows = ds.take_all()
+    assert sorted(r["v"] for r in rows) == [4, 5, 6]
+    assert all(r["year"] == 2024 for r in rows)
+
+
+def test_fast_meta_provider_skips_stat(rt_shared, tmp_path):
+    base = str(tmp_path / "tree")
+    _make_hive_tree(base)
+    ds = read_csv(base, meta_provider=FastFileMetadataProvider())
+    assert len(ds.take_all()) == 6
+    # No existence check for explicit paths:
+    mp = FastFileMetadataProvider()
+    assert mp.expand_paths("/definitely/missing.csv") == \
+        ["/definitely/missing.csv"]
+    assert mp.get_metadata("/definitely/missing.csv").size_bytes is None
+
+
+def test_write_partitioned_round_trip(rt_shared, tmp_path):
+    base = str(tmp_path / "out")
+    ds = from_items([{"year": y, "month": m, "v": v}
+                     for y, m, v in [(2023, 1, 10), (2023, 2, 20),
+                                     (2024, 1, 30), (2024, 1, 31)]],
+                    parallelism=2)
+    paths = write_partitioned(ds, JSONDatasource(), base,
+                              ["year", "month"])
+    assert paths and all(p.endswith(".json") for p in paths)
+    assert os.path.isdir(os.path.join(base, "year=2024", "month=1"))
+    from ray_tpu.data import read_json
+
+    back = read_json(base, partitioning=Partitioning(
+        PartitionStyle.HIVE, base))
+    rows = sorted(back.take_all(), key=lambda r: r["v"])
+    assert [r["v"] for r in rows] == [10, 20, 30, 31]
+    # Partition cols round-trip from the path, not the file body.
+    assert rows[2] == {"v": 30, "year": 2024, "month": 1}
+
+
+def test_partitioned_walk_skips_non_format_files(rt_shared, tmp_path):
+    """_SUCCESS markers and READMEs in hive trees must not reach the
+    format parser."""
+    base = str(tmp_path / "tree")
+    _make_hive_tree(base)
+    open(os.path.join(base, "_SUCCESS"), "w").close()
+    with open(os.path.join(base, "README.txt"), "w") as f:
+        f.write("not a csv")
+    ds = read_csv(base, partitioning=Partitioning(
+        PartitionStyle.HIVE, base))
+    assert len(ds.take_all()) == 6
+
+
+def test_numpy_partitioned_read_gets_columns(rt_shared, tmp_path):
+    import numpy as np
+    from ray_tpu.data import read_numpy
+
+    base = tmp_path / "np" / "split=train"
+    base.mkdir(parents=True)
+    np.save(base / "a.npy", np.arange(4))
+    ds = read_numpy(str(tmp_path / "np"), partitioning=Partitioning(
+        PartitionStyle.HIVE, str(tmp_path / "np")))
+    rows = ds.take_all()
+    assert len(rows) == 4
+    assert all(r["split"] == "train" for r in rows)
+
+
+def test_write_partitioned_requires_cols(rt_shared, tmp_path):
+    ds = from_items([{"a": 1}])
+    with pytest.raises(Exception, match="partition cols"):
+        write_partitioned(ds, CSVDatasource(), str(tmp_path / "x"),
+                          ["missing"])

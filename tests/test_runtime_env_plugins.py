@@ -141,6 +141,55 @@ def test_uri_cache_pinned_entries_survive_eviction():
     assert "u1" in deleted
 
 
+def test_uri_cache_new_entry_pinned_before_add_survives():
+    """A freshly materialized resource is pinned before add(): its own
+    add-triggered eviction pass must not delete it, even when every
+    other entry is pinned too."""
+    deleted = []
+    cache = URICache(max_total_bytes=100)
+    cache.add("old", 90, lambda u: deleted.append(u) or 90)
+    cache.pin("old")
+    cache.pin("new")
+    cache.add("new", 50, lambda u: deleted.append(u) or 50)
+    assert deleted == []  # over budget but everything is in use
+    cache.unpin("old")
+    cache.add("other", 10, lambda u: deleted.append(u) or 10)
+    assert deleted == ["old"]
+
+
+def test_apply_failure_releases_pins(tmp_path, monkeypatch):
+    """A later plugin raising mid-apply must unpin earlier plugins'
+    URIs (otherwise retries leak pins forever)."""
+    from ray_tpu.runtime_env import _URI_CACHE
+
+    class GoodPlugin(RuntimeEnvPlugin):
+        name = "goodres"
+        priority = 3
+
+        def get_uri(self, env):
+            return "goodres://x"
+
+        def create(self, uri, env):
+            return None, 1
+
+    class BadPlugin(RuntimeEnvPlugin):
+        name = "badres"
+        priority = 8
+
+        def create(self, uri, env):
+            raise RuntimeError("boom")
+
+    register_plugin(GoodPlugin())
+    register_plugin(BadPlugin())
+    try:
+        with pytest.raises(RuntimeError, match="boom"):
+            apply_runtime_env({"goodres": 1, "badres": 1})
+        assert "goodres://x" not in _URI_CACHE._pins
+    finally:
+        _PLUGINS.pop("goodres", None)
+        _PLUGINS.pop("badres", None)
+
+
 def test_conda_gating():
     env = RuntimeEnv(conda="some-env-that-is-not-active")
     with pytest.raises(RuntimeError, match="offline"):
