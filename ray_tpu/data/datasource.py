@@ -74,9 +74,14 @@ class Datasource:
         if (partitioning is None and partition_filter is None
                 and meta_provider is None):
             return self.expand_paths(paths)  # legacy flat listing
-        mp = meta_provider or DefaultFileMetadataProvider()
-        if mp.file_extensions is None and self.FILE_EXTENSIONS:
+        if meta_provider is None:
+            mp = DefaultFileMetadataProvider()
+            # Only internally-created providers get the format's
+            # extension filter — mutating a caller's provider would
+            # poison their later reads of other formats.
             mp.file_extensions = self.FILE_EXTENSIONS
+        else:
+            mp = meta_provider
         files = mp.expand_paths(paths)
         if partition_filter is not None:
             files = partition_filter(files)

@@ -106,10 +106,9 @@ class PathPartitionParser:
                     out[k] = v
             return out
         names = self.scheme.field_names or ()
-        # Directory style needs the full declared depth; partial paths
-        # are ambiguous.
-        segments = segments[-len(names):] if len(segments) >= len(
-            names) else segments
+        # Directory style needs EXACTLY the declared depth; shallower
+        # and deeper trees are both ambiguous (deeper would silently
+        # shift which segment maps to which field).
         if len(segments) != len(names):
             raise ValueError(
                 f"path {path!r} has {len(segments)} partition levels "

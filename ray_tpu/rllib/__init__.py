@@ -5,8 +5,12 @@ Reference analog: ``rllib/`` (Algorithm/AlgorithmConfig, PPO,
 RolloutWorker/WorkerSet, SampleBatch, env abstractions).
 """
 
+from .a2c import A2C, A2CConfig
 from .algorithm import Algorithm, AlgorithmConfig, WorkerSet
 from .appo import APPO, APPOConfig
+from .bandit import BanditEnv, LinTS, LinUCB, run_bandit
+from .cql import CQL, CQLConfig
+from .es import ARS, ARSConfig, ES, ESConfig, SharedNoiseTable
 from .dqn import DQN, DQNConfig
 from .env import (
     AtariSim,
@@ -77,6 +81,9 @@ __all__ = [
     "restore_connectors_for_policy",
     "ExternalDQNWorker", "ExternalEnv", "ExternalEnvWorker",
     "PolicyClient", "PolicyServerInput",
+    "A2C", "A2CConfig", "ARS", "ARSConfig", "BanditEnv", "CQL",
+    "CQLConfig", "ES", "ESConfig", "LinTS", "LinUCB", "run_bandit",
+    "SharedNoiseTable",
     "Algorithm", "AlgorithmConfig", "ApexConfig", "ApexDQN",
     "AtariSim", "DQN", "DQNConfig",
     "FastCartPole", "FastPendulum", "GymVectorEnv", "Impala",

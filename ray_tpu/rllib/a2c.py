@@ -1,0 +1,134 @@
+"""A2C: synchronous advantage actor-critic.
+
+Reference analog: ``rllib/algorithms/a2c/a2c.py`` — A2C is sync
+parallel sampling + ONE on-policy gradient step per batch on the plain
+policy-gradient surrogate (no ratio clipping, no SGD epochs; A3C's
+microbatch path collapses to this in the synchronous setting). The whole
+update is one jit program on the learner.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    OBS,
+    VALUE_TARGETS,
+    SampleBatch,
+    compute_gae,
+    flatten_time_major,
+)
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self._algo_class = A2C
+        self.lr = 1e-3
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.lambda_ = 1.0  # A2C default: plain n-step returns
+        self.grad_clip = 0.5
+        self.rollout_fragment_length = 20
+        self.num_envs_per_worker = 16
+
+    def training(self, vf_loss_coeff=None, entropy_coeff=None,
+                 lambda_=None, grad_clip=None, **kwargs) -> "A2CConfig":
+        super().training(**kwargs)
+        for name, val in [("vf_loss_coeff", vf_loss_coeff),
+                          ("entropy_coeff", entropy_coeff),
+                          ("lambda_", lambda_), ("grad_clip", grad_clip)]:
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+def a2c_loss(params, batch, vf_coeff, ent_coeff, apply_fn):
+    logits, values = apply_fn(params, batch[OBS])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch[ACTIONS].astype(jnp.int32)[..., None],
+        axis=-1)[..., 0]
+    adv = batch[ADVANTAGES]
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    # No importance ratio: the batch IS on-policy (single sync step).
+    policy_loss = -jnp.mean(logp * adv)
+    vf_loss = jnp.mean((values - batch[VALUE_TARGETS]) ** 2)
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    total = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
+    return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                   "entropy": entropy}
+
+
+class A2C(Algorithm):
+    def setup(self, config: A2CConfig) -> None:
+        super().setup(config)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip),
+            optax.adam(config.lr),
+        )
+        self.params = jax.tree.map(
+            jnp.asarray, self.workers.local_worker.policy.params)
+        self.opt_state = self.optimizer.init(self.params)
+        apply_fn = self.workers.local_worker.policy.net.apply
+        vfc, eco = config.vf_loss_coeff, config.entropy_coeff
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                a2c_loss, has_aux=True)(params, batch, vfc, eco, apply_fn)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            return optax.apply_updates(params, updates), opt_state, \
+                {"total_loss": loss, **aux}
+
+        self._update = update
+        self.workers.sync_weights(jax.tree.map(np.asarray, self.params))
+
+    def training_step(self) -> Dict:
+        cfg: A2CConfig = self.config
+        fragments = self.workers.sample(cfg.rollout_fragment_length)
+        processed = []
+        for frag in fragments:
+            last_values = frag.pop("last_values")
+            frag.pop("final_obs", None)
+            frag = compute_gae(frag, last_values, cfg.gamma, cfg.lambda_)
+            processed.append(flatten_time_major(frag))
+        batch = SampleBatch.concat_samples(processed)
+        steps = batch.count
+        self._timesteps_total += steps
+        device_batch = {k: jnp.asarray(v) for k, v in batch.items()
+                       if k in (OBS, ACTIONS, ADVANTAGES, VALUE_TARGETS)}
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, device_batch)
+        weights = jax.tree.map(np.asarray, self.params)
+        self.workers.local_worker.set_weights(weights)
+        self.workers.sync_weights(weights)
+        out = {k: float(v) for k, v in metrics.items()}
+        out["timesteps_this_iter"] = steps
+        return out
+
+    def get_state(self) -> Dict:
+        state = super().get_state()
+        state["params"] = jax.tree.map(np.asarray, self.params)
+        state["opt_state"] = jax.tree.map(np.asarray, self.opt_state)
+        return state
+
+    def set_state(self, state: Dict) -> None:
+        super().set_state(state)
+        if "params" in state:
+            self.params = jax.tree.map(jnp.asarray, state["params"])
+            weights = jax.tree.map(np.asarray, self.params)
+            self.workers.local_worker.set_weights(weights)
+            self.workers.sync_weights(weights)
+        if "opt_state" in state:
+            self.opt_state = jax.tree.map(jnp.asarray,
+                                          state["opt_state"])

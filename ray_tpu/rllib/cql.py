@@ -1,0 +1,302 @@
+"""CQL: conservative Q-learning for offline continuous control.
+
+Reference analog: ``rllib/algorithms/cql/cql.py`` + ``cql_torch_policy.py``
+(Kumar et al. 2020) — SAC's twin-Q learner trained purely from logged
+data, with the CQL(H) conservative penalty pushing Q down on
+out-of-distribution actions (logsumexp over random + policy actions)
+and up on dataset actions, so the learned policy cannot exploit
+erroneously optimistic Q estimates where the data has no coverage.
+
+Reuses the SAC building blocks (`init_sac_params`, `sample_action`,
+`_q`); the entire update (critics + penalty, actor with optional
+behavior-cloning warmup, alpha, polyak) is one jit program.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .offline import JsonReader
+from .sac import _q, actor_dist, init_sac_params, sample_action
+from .sample_batch import ACTIONS, DONES, NEXT_OBS, OBS, REWARDS
+
+
+class CQLConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self._algo_class = CQL
+        self.input_path: Optional[str] = None  # JsonReader dir
+        self.action_dim = 1
+        self.action_low = -2.0
+        self.action_high = 2.0
+        self.lr = 3e-4
+        self.train_batch_size = 256
+        self.num_updates_per_iter = 64
+        self.tau = 0.005
+        self.min_q_weight = 5.0     # conservative penalty scale
+        self.num_penalty_actions = 10
+        self.bc_iters = 200         # actor warmup: pure behavior cloning
+        self.initial_alpha = 0.2
+        self.target_entropy: Optional[float] = None
+        self.policy_hidden = (256, 256)
+
+    def offline_data(self, input_path: str) -> "CQLConfig":
+        self.input_path = input_path
+        return self
+
+    def training(self, **kwargs) -> "CQLConfig":
+        for k in ("min_q_weight", "num_penalty_actions", "bc_iters",
+                  "tau", "num_updates_per_iter", "initial_alpha",
+                  "target_entropy"):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        super().training(**kwargs)
+        return self
+
+
+def cql_critic_loss(params, batch, key, cfg_static):
+    """Twin-Q TD loss + CQL(H) penalty.
+
+    penalty = logsumexp over {uniform-random, pi(s), pi(s')} actions of
+    Q(s, a) (importance-corrected) minus Q(s, a_data); reference:
+    cql_torch_policy.py cql_loss."""
+    (adim, low, high, gamma, n_pen, min_q_w) = cfg_static
+    obs, acts = batch[OBS], batch[ACTIONS]
+    b = obs.shape[0]
+    k_next, k_rand, k_pi, k_pin = jax.random.split(key, 4)
+
+    # Standard SAC TD target from the polyak critics.
+    next_a, next_logp = sample_action(params["actor"], batch[NEXT_OBS],
+                                      k_next, adim, low, high)
+    tq = jnp.minimum(
+        _q(params["target_q1"], batch[NEXT_OBS], next_a),
+        _q(params["target_q2"], batch[NEXT_OBS], next_a),
+    )
+    alpha = jnp.exp(params["log_alpha"])
+    not_done = 1.0 - batch[DONES].astype(jnp.float32)
+    target = batch[REWARDS] + gamma * not_done * (
+        tq - alpha * next_logp)
+    target = jax.lax.stop_gradient(target)
+    q1_data = _q(params["q1"], obs, acts)
+    q2_data = _q(params["q2"], obs, acts)
+    td_loss = jnp.mean((q1_data - target) ** 2) + jnp.mean(
+        (q2_data - target) ** 2)
+
+    # --- CQL(H) penalty ---------------------------------------------------
+    def tiled(o):
+        return jnp.repeat(o, n_pen, axis=0)  # [B*N, d]
+
+    rand_a = jax.random.uniform(k_rand, (b * n_pen, adim),
+                                minval=low, maxval=high)
+    # log density of the uniform proposal (importance correction)
+    log_unif = -adim * jnp.log(high - low)
+    pi_a, pi_logp = sample_action(params["actor"], tiled(obs), k_pi,
+                                  adim, low, high)
+    pin_a, pin_logp = sample_action(params["actor"],
+                                    tiled(batch[NEXT_OBS]), k_pin,
+                                    adim, low, high)
+    pi_a = jax.lax.stop_gradient(pi_a)
+    pin_a = jax.lax.stop_gradient(pin_a)
+
+    def penalty(qp):
+        q_rand = _q(qp, tiled(obs), rand_a).reshape(b, n_pen) - log_unif
+        q_pi = (_q(qp, tiled(obs), pi_a).reshape(b, n_pen)
+                - jax.lax.stop_gradient(pi_logp).reshape(b, n_pen))
+        q_pin = (_q(qp, tiled(obs), pin_a).reshape(b, n_pen)
+                 - jax.lax.stop_gradient(pin_logp).reshape(b, n_pen))
+        cat = jnp.concatenate([q_rand, q_pi, q_pin], axis=1)
+        return jnp.mean(jax.nn.logsumexp(cat, axis=1))
+
+    cql1 = penalty(params["q1"]) - jnp.mean(q1_data)
+    cql2 = penalty(params["q2"]) - jnp.mean(q2_data)
+    total = td_loss + min_q_w * (cql1 + cql2)
+    return total, {"td_loss": td_loss, "cql_penalty": cql1 + cql2,
+                   "q_data_mean": jnp.mean(q1_data)}
+
+
+def cql_actor_loss(actor, params, batch, key, bc_phase, cfg_static):
+    """SAC actor objective after bc_iters; pure log-likelihood behavior
+    cloning before (reference: cql.py bc_iters warmup)."""
+    (adim, low, high, *_rest) = cfg_static
+    a_pi, logp = sample_action(actor, batch[OBS], key, adim, low, high)
+    alpha = jax.lax.stop_gradient(jnp.exp(params["log_alpha"]))
+    q = jnp.minimum(_q(params["q1"], batch[OBS], a_pi),
+                    _q(params["q2"], batch[OBS], a_pi))
+    sac_obj = jnp.mean(alpha * logp - q)
+    # BC: maximize the squashed-Gaussian mean's proximity to the data
+    # action (an MSE surrogate for logp of the logged action).
+    mean, _ = actor_dist(actor, batch[OBS], adim)
+    scale = (high - low) / 2.0
+    mean_act = low + (jnp.tanh(mean) + 1.0) * scale
+    bc_obj = jnp.mean((mean_act - batch[ACTIONS]) ** 2)
+    return jnp.where(bc_phase, bc_obj, sac_obj), logp
+
+
+class CQL(Algorithm):
+    """Fully offline: no rollout workers; data comes from JsonReader."""
+
+    def __init__(self, config: CQLConfig):
+        from ..core import runtime as runtime_mod
+
+        runtime_mod.auto_init()
+        self.config = config
+        self.iteration = 0
+        self._timesteps_total = 0
+        self.setup(config)
+
+    def setup(self, config: CQLConfig) -> None:
+        if not config.input_path:
+            raise ValueError("CQL needs config.offline_data(input_path)")
+        batch = JsonReader(config.input_path).read_all()
+        self._data = {
+            OBS: np.asarray(batch[OBS], np.float32),
+            ACTIONS: np.asarray(batch[ACTIONS], np.float32),
+            REWARDS: np.asarray(batch[REWARDS], np.float32),
+            NEXT_OBS: np.asarray(batch[NEXT_OBS], np.float32),
+            DONES: np.asarray(batch[DONES]),
+        }
+        if self._data[ACTIONS].ndim == 1:
+            self._data[ACTIONS] = self._data[ACTIONS][:, None]
+        self._n = len(self._data[OBS])
+        obs_dim = int(np.prod(self._data[OBS].shape[1:]))
+        adim = config.action_dim
+        self.params = init_sac_params(
+            jax.random.PRNGKey(config.seed), obs_dim, adim,
+            config.policy_hidden)
+        self.params["log_alpha"] = jnp.asarray(
+            np.log(config.initial_alpha))
+        self._rng = jax.random.PRNGKey(config.seed + 1)
+        self._np_rng = np.random.default_rng(config.seed + 2)
+        self.critic_opt = optax.adam(config.lr)
+        self.actor_opt = optax.adam(config.lr)
+        self.alpha_opt = optax.adam(config.lr)
+        critic_params = {k: self.params[k] for k in ("q1", "q2")}
+        self.critic_state = self.critic_opt.init(critic_params)
+        self.actor_state = self.actor_opt.init(self.params["actor"])
+        self.alpha_state = self.alpha_opt.init(self.params["log_alpha"])
+        target_entropy = (config.target_entropy
+                          if config.target_entropy is not None
+                          else -float(adim))
+        cfg_static = (adim, config.action_low, config.action_high,
+                      config.gamma, config.num_penalty_actions,
+                      config.min_q_weight)
+        tau = config.tau
+
+        @jax.jit
+        def update(params, copt, aopt, lopt, batch, key, bc_phase):
+            k1, k2, k3 = jax.random.split(key, 3)
+            critic_params = {"q1": params["q1"], "q2": params["q2"]}
+
+            def critic_loss_fn(cp):
+                p = dict(params)
+                p.update(cp)
+                return cql_critic_loss(p, batch, k1, cfg_static)
+
+            (closs, caux), cgrads = jax.value_and_grad(
+                critic_loss_fn, has_aux=True)(critic_params)
+            cupd, copt = self.critic_opt.update(cgrads, copt,
+                                                critic_params)
+            critic_params = optax.apply_updates(critic_params, cupd)
+            params = dict(params)
+            params.update(critic_params)
+
+            (aloss, logp), agrads = jax.value_and_grad(
+                cql_actor_loss, has_aux=True)(
+                params["actor"], params, batch, k2, bc_phase,
+                cfg_static)
+            aupd, aopt = self.actor_opt.update(agrads, aopt,
+                                               params["actor"])
+            params["actor"] = optax.apply_updates(params["actor"], aupd)
+
+            def alpha_loss_fn(log_alpha):
+                return -jnp.mean(jnp.exp(log_alpha) * jax.lax.
+                                 stop_gradient(logp + target_entropy))
+
+            lgrad = jax.grad(alpha_loss_fn)(params["log_alpha"])
+            lupd, lopt = self.alpha_opt.update(lgrad, lopt,
+                                               params["log_alpha"])
+            params["log_alpha"] = optax.apply_updates(
+                params["log_alpha"], lupd)
+
+            for q in ("q1", "q2"):
+                params[f"target_{q}"] = jax.tree.map(
+                    lambda t, s: (1 - tau) * t + tau * s,
+                    params[f"target_{q}"], params[q])
+            return params, copt, aopt, lopt, {
+                "critic_loss": closs, "actor_loss": aloss, **caux}
+
+        self._update = update
+        self._num_updates = 0
+
+    def _sample_batch(self) -> Dict:
+        idx = self._np_rng.integers(0, self._n,
+                                    self.config.train_batch_size)
+        return {k: jnp.asarray(v[idx]) for k, v in self._data.items()}
+
+    def training_step(self) -> Dict:
+        cfg: CQLConfig = self.config
+        metrics = {}
+        for _ in range(cfg.num_updates_per_iter):
+            self._rng, sub = jax.random.split(self._rng)
+            bc = jnp.asarray(self._num_updates < cfg.bc_iters)
+            (self.params, self.critic_state, self.actor_state,
+             self.alpha_state, metrics) = self._update(
+                self.params, self.critic_state, self.actor_state,
+                self.alpha_state, self._sample_batch(), sub, bc)
+            self._num_updates += 1
+        self._timesteps_total += (cfg.num_updates_per_iter
+                                  * cfg.train_batch_size)
+        return {k: float(v) for k, v in metrics.items()} | {
+            "timesteps_this_iter": cfg.num_updates_per_iter
+            * cfg.train_batch_size,
+            "num_updates": self._num_updates,
+        }
+
+    def train(self) -> Dict:
+        import time
+
+        t0 = time.perf_counter()
+        result = self.training_step()
+        self.iteration += 1
+        result.update({"training_iteration": self.iteration,
+                       "timesteps_total": self._timesteps_total,
+                       "time_this_iter_s": time.perf_counter() - t0})
+        return result
+
+    def q_values(self, obs: np.ndarray, actions: np.ndarray) -> np.ndarray:
+        """min(Q1, Q2) — exposed for conservatism checks/eval."""
+        obs = jnp.asarray(obs, jnp.float32)
+        actions = jnp.asarray(actions, jnp.float32)
+        return np.asarray(jnp.minimum(
+            _q(self.params["q1"], obs, actions),
+            _q(self.params["q2"], obs, actions)))
+
+    def compute_single_action(self, obs: np.ndarray) -> np.ndarray:
+        mean, _ = actor_dist(self.params["actor"],
+                             jnp.asarray(obs, jnp.float32)[None],
+                             self.config.action_dim)
+        scale = (self.config.action_high - self.config.action_low) / 2.0
+        act = self.config.action_low + (jnp.tanh(mean) + 1.0) * scale
+        return np.asarray(act)[0]
+
+    def get_state(self) -> Dict:
+        return {"iteration": self.iteration,
+                "timesteps_total": self._timesteps_total,
+                "num_updates": self._num_updates,
+                "params": jax.tree.map(np.asarray, self.params)}
+
+    def set_state(self, state: Dict) -> None:
+        self.iteration = state.get("iteration", 0)
+        self._timesteps_total = state.get("timesteps_total", 0)
+        self._num_updates = state.get("num_updates", 0)
+        if "params" in state:
+            self.params = jax.tree.map(jnp.asarray, state["params"])
+
+    def stop(self) -> None:
+        pass

@@ -95,21 +95,28 @@ class RuntimeEnvContext:
 
     def apply(self) -> Dict[str, Any]:
         undo: Dict[str, Any] = {}
-        if self.env_vars:
-            undo["env_vars"] = {k: os.environ.get(k)
-                                for k in self.env_vars}
-            os.environ.update(self.env_vars)
-        if self.working_dir:
-            undo["cwd"] = os.getcwd()
-            os.chdir(self.working_dir)
-        # Each path is inserted at 0 in plugin-priority order, so LATER
-        # plugins end up in FRONT: pip-materialized packages shadow
-        # py_modules, which shadow working_dir — a pinned pip version
-        # must beat a stale copy sitting in the working dir.
-        for p in self.sys_paths:
-            sys.path.insert(0, p)
-        if self.sys_paths:
-            undo["extra_paths"] = list(self.sys_paths)
+        try:
+            if self.env_vars:
+                undo["env_vars"] = {k: os.environ.get(k)
+                                    for k in self.env_vars}
+                os.environ.update(self.env_vars)
+            if self.working_dir:
+                undo["cwd"] = os.getcwd()
+                os.chdir(self.working_dir)
+            # Each path is inserted at 0 in plugin-priority order, so
+            # LATER plugins end up in FRONT: pip-materialized packages
+            # shadow py_modules, which shadow working_dir — a pinned pip
+            # version must beat a stale copy in the working dir.
+            for p in self.sys_paths:
+                sys.path.insert(0, p)
+            if self.sys_paths:
+                undo["extra_paths"] = list(self.sys_paths)
+        except Exception:
+            # Half-applied process state is worse than no env: revert
+            # whatever already mutated (the caller gets no undo info on
+            # an exception path).
+            restore_runtime_env(undo)
+            raise
         return undo
 
 
@@ -488,13 +495,14 @@ def apply_runtime_env(env: Optional[Dict]) -> Dict[str, Any]:
                 if uri is not None and nbytes:
                     _URI_CACHE.add(uri, nbytes, plugin.delete_uri)
             plugin.modify_context(uri, env, ctx)
+        undo = ctx.apply()
     except Exception:
-        # A later plugin failed: release pins taken so far — the caller
-        # never receives undo info, so restore_runtime_env can't.
+        # A later plugin (or the apply itself) failed: release pins
+        # taken so far — the caller never receives undo info, so
+        # restore_runtime_env can't.
         for uri in pinned:
             _URI_CACHE.unpin(uri)
         raise
-    undo = ctx.apply()
     if pinned:
         undo["pinned_uris"] = pinned
     return undo

@@ -56,7 +56,11 @@ def test_directory_parser_depth_checked(tmp_path):
     assert parser(str(tmp_path / "2024" / "07" / "f.csv")) == \
         {"year": "2024", "month": "07"}
     with pytest.raises(ValueError, match="partition levels"):
-        parser(str(tmp_path / "2024" / "f.csv"))
+        parser(str(tmp_path / "2024" / "f.csv"))  # too shallow
+    with pytest.raises(ValueError, match="partition levels"):
+        # Too deep: silently taking the last 2 levels would map wrong
+        # segments to fields.
+        parser(str(tmp_path / "2024" / "07" / "backfill" / "f.csv"))
     with pytest.raises(ValueError, match="field_names"):
         Partitioning(PartitionStyle.DIRECTORY, str(tmp_path))
 

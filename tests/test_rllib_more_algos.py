@@ -1,0 +1,185 @@
+"""A2C, ES/ARS, CQL, and contextual bandit tests
+(reference: rllib/algorithms/{a2c,es,ars,cql,bandit}/tests)."""
+
+import numpy as np
+import pytest
+
+
+def test_a2c_learns_cartpole(rt_shared):
+    from ray_tpu.rllib import A2CConfig
+
+    algo = (A2CConfig()
+            .environment("FastCartPole")
+            .rollouts(num_rollout_workers=0, num_envs_per_worker=16,
+                      rollout_fragment_length=20)
+            .training(lr=2e-3)
+            .debugging(seed=1)
+            .build())
+    best = 0.0
+    for _ in range(60):
+        result = algo.train()
+        best = max(best, result.get("episode_reward_mean") or 0.0)
+        if best >= 100:
+            break
+    algo.stop()
+    assert best >= 100, f"A2C failed to learn: best={best}"
+
+
+def test_es_improves_cartpole(rt_shared):
+    from ray_tpu.rllib import ESConfig
+
+    algo = (ESConfig()
+            .environment("FastCartPole")
+            .rollouts(num_rollout_workers=2)
+            .training(episodes_per_batch=12, sigma=0.1, step_size=0.1,
+                      noise_size=200_000)
+            .debugging(seed=0)
+            .build())
+    algo.config.policy_config_extra["max_episode_steps"] = 200
+    first = algo.evaluate(episodes=3)
+    for _ in range(12):
+        result = algo.train()
+    final = algo.evaluate(episodes=3)
+    algo.stop()
+    # Gradient-free improvement: mean return strictly grows.
+    assert final > first + 20, f"ES did not improve: {first} -> {final}"
+
+
+def test_ars_improves_cartpole(rt_shared):
+    from ray_tpu.rllib import ARSConfig
+
+    algo = (ARSConfig()
+            .environment("FastCartPole")
+            .rollouts(num_rollout_workers=2)
+            .training(episodes_per_batch=12, sigma=0.1, step_size=0.15,
+                      top_k=6, noise_size=200_000)
+            .debugging(seed=3)
+            .build())
+    algo.config.policy_config_extra["max_episode_steps"] = 200
+    first = algo.evaluate(episodes=3)
+    for _ in range(12):
+        algo.train()
+    final = algo.evaluate(episodes=3)
+    algo.stop()
+    assert final > first + 20, f"ARS did not improve: {first} -> {final}"
+
+
+def test_es_noise_table_deterministic():
+    from ray_tpu.rllib import SharedNoiseTable
+
+    t1 = SharedNoiseTable(10_000, seed=7)
+    t2 = SharedNoiseTable(10_000, seed=7)
+    np.testing.assert_array_equal(t1.get(123, 64), t2.get(123, 64))
+
+
+def test_linucb_sublinear_regret():
+    from ray_tpu.rllib import BanditEnv, LinUCB, run_bandit
+
+    env = BanditEnv(num_arms=4, context_dim=8, noise=0.1, seed=0)
+    out = run_bandit(LinUCB(4, 8, alpha=1.0), env, steps=2000)
+    # The policy converges: late-window per-step regret far below the
+    # early average, and cumulative regret well under the random-policy
+    # linear growth (~0.5/step here).
+    assert out["final_window_regret"] < 0.1, out["final_window_regret"]
+    assert out["cumulative_regret"] < 400
+
+    rand_env = BanditEnv(num_arms=4, context_dim=8, noise=0.1, seed=0)
+    rng = np.random.default_rng(0)
+
+    class RandomPolicy:
+        def select_arm(self, x):
+            return int(rng.integers(0, 4))
+
+        def update(self, *a):
+            pass
+
+    rand = run_bandit(RandomPolicy(), rand_env, steps=2000)
+    assert out["cumulative_regret"] < rand["cumulative_regret"] / 3
+
+
+def test_lints_sublinear_regret():
+    from ray_tpu.rllib import BanditEnv, LinTS, run_bandit
+
+    env = BanditEnv(num_arms=4, context_dim=8, noise=0.1, seed=1)
+    out = run_bandit(LinTS(4, 8, nu=0.3, seed=1), env, steps=2000)
+    assert out["final_window_regret"] < 0.1
+    assert out["cumulative_regret"] < 400
+
+
+@pytest.fixture(scope="module")
+def pendulum_dataset(tmp_path_factory):
+    """Logged random-policy pendulum transitions for offline tests."""
+    from ray_tpu.rllib.env import FastPendulum
+    from ray_tpu.rllib.offline import JsonWriter
+    from ray_tpu.rllib.sample_batch import (ACTIONS, DONES, NEXT_OBS,
+                                            OBS, REWARDS, SampleBatch)
+
+    path = str(tmp_path_factory.mktemp("cql_data"))
+    env = FastPendulum(num_envs=8, seed=0)
+    rng = np.random.default_rng(0)
+    writer = JsonWriter(path)
+    obs = env.vector_reset()
+    for _ in range(120):
+        acts = rng.uniform(-2, 2, size=(8, 1)).astype(np.float32)
+        nobs, rews, dones, _ = env.vector_step(acts)
+        writer.write(SampleBatch({
+            OBS: obs.copy(), ACTIONS: acts, REWARDS: rews,
+            NEXT_OBS: nobs.copy(), DONES: dones,
+        }))
+        obs = nobs
+    writer.close()
+    return path
+
+
+def test_cql_trains_and_is_conservative(rt_shared, pendulum_dataset):
+    from ray_tpu.rllib import CQLConfig
+
+    algo = (CQLConfig()
+            .offline_data(pendulum_dataset)
+            .training(train_batch_size=128, num_updates_per_iter=50,
+                      min_q_weight=5.0, bc_iters=50)
+            .debugging(seed=0)
+            .build())
+    algo.config.action_dim = 1
+    for _ in range(4):
+        result = algo.train()
+    assert np.isfinite(result["critic_loss"])
+    # The defining CQL property: Q on dataset actions >= Q on random
+    # (out-of-distribution) actions for the same states.
+    obs = algo._data["obs"][:256]
+    data_acts = algo._data["actions"][:256]
+    rng = np.random.default_rng(1)
+    rand_acts = rng.uniform(-2, 2, size=data_acts.shape).astype(
+        np.float32)
+    q_data = algo.q_values(obs, data_acts).mean()
+    q_rand = algo.q_values(obs, rand_acts).mean()
+    assert q_data > q_rand, (q_data, q_rand)
+    act = algo.compute_single_action(obs[0])
+    assert act.shape == (1,) and -2.0 <= float(act[0]) <= 2.0
+    algo.stop()
+
+
+def test_cql_penalty_widens_gap(rt_shared, pendulum_dataset):
+    """min_q_weight > 0 produces a larger data-vs-random Q gap than
+    weight 0 (the penalty is doing the work, not the TD loss)."""
+    from ray_tpu.rllib import CQLConfig
+
+    gaps = {}
+    for w in (0.0, 5.0):
+        algo = (CQLConfig()
+                .offline_data(pendulum_dataset)
+                .training(train_batch_size=128,
+                          num_updates_per_iter=40, min_q_weight=w,
+                          bc_iters=10_000)  # actor stays BC: isolate Q
+                .debugging(seed=0)
+                .build())
+        for _ in range(3):
+            algo.train()
+        obs = algo._data["obs"][:256]
+        data_acts = algo._data["actions"][:256]
+        rand_acts = np.random.default_rng(1).uniform(
+            -2, 2, size=data_acts.shape).astype(np.float32)
+        gaps[w] = float(algo.q_values(obs, data_acts).mean()
+                        - algo.q_values(obs, rand_acts).mean())
+        algo.stop()
+    assert gaps[5.0] > gaps[0.0], gaps
