@@ -47,6 +47,9 @@ ST_OK = 0
 ST_ERR = 1
 ST_NIL = 2
 
+_OP_NAMES = {v: k[3:].lower() for k, v in list(globals().items())
+             if k.startswith("OP_")}
+
 
 class ControlStoreError(Exception):
     pass
@@ -114,10 +117,18 @@ class ControlStoreClient:
 
     # -- wire -------------------------------------------------------------
     def _call(self, op: int, body: bytes = b"") -> _FrameReader:
+        import time as _time
+
         frame = bytes([op]) + body
+        t0 = _time.perf_counter()
         with self._lock:
             self._sock.sendall(struct.pack("<I", len(frame)) + frame)
             reply = _recv_frame(self._sock)
+        from ..observability import event_stats
+
+        event_stats.record(
+            f"control_store.{_OP_NAMES.get(op, op)}",
+            _time.perf_counter() - t0)
         r = _FrameReader(reply)
         status = r.u8()
         if status == ST_ERR:

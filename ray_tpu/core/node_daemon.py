@@ -131,6 +131,12 @@ class NodeDaemon:
             self.shutdown()
 
     def _handle(self, msg: tuple) -> None:
+        from ..observability import event_stats
+
+        with event_stats.measure(f"daemon.{msg[0]}"):
+            self._handle_impl(msg)
+
+    def _handle_impl(self, msg: tuple) -> None:
         kind = msg[0]
         if kind == "spawn_worker":
             token = msg[1] if len(msg) > 1 else 0

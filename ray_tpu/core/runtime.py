@@ -1240,6 +1240,16 @@ class Runtime:
 
     # ---------------------------------------------------- worker messages
     def _handle_worker_message(self, worker: WorkerHandle, msg: tuple) -> None:
+        # Instrumented like the reference's event loops
+        # (asio/instrumented_io_context.h): per-kind latency/count
+        # aggregates surface via the state API and `rt status -v`.
+        from ..observability import event_stats
+
+        with event_stats.measure(f"runtime.worker_msg.{msg[0]}"):
+            self._handle_worker_message_impl(worker, msg)
+
+    def _handle_worker_message_impl(self, worker: WorkerHandle,
+                                    msg: tuple) -> None:
         kind = msg[0]
         if kind == "register":
             return
@@ -1535,6 +1545,13 @@ class Runtime:
         try_finish(False)
 
     def _handle_worker_rpc(self, worker: WorkerHandle, msg: tuple) -> None:
+        from ..observability import event_stats
+
+        with event_stats.measure(f"runtime.worker_rpc.{msg[0]}"):
+            self._handle_worker_rpc_impl(worker, msg)
+
+    def _handle_worker_rpc_impl(self, worker: WorkerHandle,
+                                msg: tuple) -> None:
         kind, req_id = msg[0], msg[1]
         try:
             if kind == "fetch_object":

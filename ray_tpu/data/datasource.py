@@ -74,15 +74,12 @@ class Datasource:
         if (partitioning is None and partition_filter is None
                 and meta_provider is None):
             return self.expand_paths(paths)  # legacy flat listing
-        if meta_provider is None:
-            mp = DefaultFileMetadataProvider()
-            # Only internally-created providers get the format's
-            # extension filter — mutating a caller's provider would
-            # poison their later reads of other formats.
-            mp.file_extensions = self.FILE_EXTENSIONS
-        else:
-            mp = meta_provider
-        files = mp.expand_paths(paths)
+        mp = meta_provider or DefaultFileMetadataProvider()
+        # The format's extension filter goes per-call (a provider whose
+        # own file_extensions is set wins only when the call passes
+        # none) so a caller's shared provider is never mutated.
+        files = mp.expand_paths(
+            paths, file_extensions=self.FILE_EXTENSIONS)
         if partition_filter is not None:
             files = partition_filter(files)
         return files

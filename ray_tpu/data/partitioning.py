@@ -159,10 +159,15 @@ class FileMetadataProvider:
     """Expands read paths and supplies per-file metadata
     (reference: file_meta_provider.py:22)."""
 
-    #: extensions this expansion keeps (None = keep everything)
+    #: extensions this expansion keeps (None = keep everything). The
+    #: reading datasource passes its format's extensions per call
+    #: (``file_extensions=``), which takes precedence so a shared
+    #: provider instance never needs mutating.
     file_extensions: Optional[Tuple[str, ...]] = None
 
-    def expand_paths(self, paths, *, recursive: bool = True) -> List[str]:
+    def expand_paths(self, paths, *, recursive: bool = True,
+                     file_extensions: Optional[Tuple[str, ...]] = None
+                     ) -> List[str]:
         raise NotImplementedError
 
     def get_metadata(self, path: str) -> FileMetadata:
@@ -173,7 +178,9 @@ class DefaultFileMetadataProvider(FileMetadataProvider):
     """Walks directories recursively, checks existence, stats sizes
     (reference: file_meta_provider.py:125)."""
 
-    def expand_paths(self, paths, *, recursive: bool = True) -> List[str]:
+    def expand_paths(self, paths, *, recursive: bool = True,
+                     file_extensions: Optional[Tuple[str, ...]] = None
+                     ) -> List[str]:
         import glob as _glob
 
         if isinstance(paths, str):
@@ -197,9 +204,10 @@ class DefaultFileMetadataProvider(FileMetadataProvider):
                 out.append(p)
             else:
                 raise FileNotFoundError(p)
-        if self.file_extensions:
-            out = [p for p in out
-                   if p.lower().endswith(self.file_extensions)]
+        exts = (file_extensions if file_extensions is not None
+                else self.file_extensions)
+        if exts:
+            out = [p for p in out if p.lower().endswith(tuple(exts))]
         if not out:
             raise FileNotFoundError(f"no files matched {paths}")
         return out
@@ -217,7 +225,9 @@ class FastFileMetadataProvider(DefaultFileMetadataProvider):
     speed on huge path lists (reference: file_meta_provider.py:189,
     which warns exactly this tradeoff)."""
 
-    def expand_paths(self, paths, *, recursive: bool = True) -> List[str]:
+    def expand_paths(self, paths, *, recursive: bool = True,
+                     file_extensions: Optional[Tuple[str, ...]] = None
+                     ) -> List[str]:
         import glob as _glob
 
         if isinstance(paths, str):
@@ -226,7 +236,9 @@ class FastFileMetadataProvider(DefaultFileMetadataProvider):
         for p in paths:
             if os.path.isdir(p):
                 # Directory walks are unavoidable; files pass unstated.
-                out.extend(super().expand_paths([p], recursive=recursive))
+                out.extend(super().expand_paths(
+                    [p], recursive=recursive,
+                    file_extensions=file_extensions))
             elif any(c in p for c in "*?["):
                 out.extend(sorted(_glob.glob(p)))
             else:

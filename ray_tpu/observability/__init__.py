@@ -7,8 +7,11 @@ Reference analog: ``ray.util.metrics``, ``ray.experimental.state.api``,
 from .dashboard import Dashboard, start_dashboard, stop_dashboard
 from .events import EventLog, Severity, emit, global_event_log
 from .metrics import Counter, Gauge, Histogram, core_metrics, registry
+from .event_stats import EventStats, global_event_stats
 from .state import (
+    actor_detail,
     cluster_status,
+    event_loop_stats,
     list_actors,
     list_nodes,
     list_objects,
@@ -21,8 +24,10 @@ from .state import (
 )
 
 __all__ = [
-    "Counter", "Dashboard", "EventLog", "Gauge", "Histogram", "Severity",
-    "cluster_status", "core_metrics", "emit", "global_event_log",
+    "Counter", "Dashboard", "EventLog", "EventStats", "Gauge",
+    "Histogram", "Severity", "actor_detail",
+    "cluster_status", "core_metrics", "emit", "event_loop_stats",
+    "global_event_log", "global_event_stats",
     "list_actors", "list_nodes", "list_objects", "list_placement_groups",
     "list_tasks", "list_workers", "record_span", "registry",
     "start_dashboard", "stop_dashboard", "summarize_tasks", "timeline",

@@ -57,50 +57,137 @@ def node_stats() -> dict:
 
 _INDEX_HTML = """<!doctype html>
 <html><head><title>ray_tpu dashboard</title><style>
-body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
-h1{font-size:1.2rem} h2{font-size:1rem;margin-top:1.2rem}
-table{border-collapse:collapse;font-size:.85rem;width:100%}
+body{font-family:system-ui,sans-serif;margin:0;background:#fafafa}
+header{background:#1a1c23;color:#fff;padding:.6rem 1.2rem;display:flex;
+  align-items:baseline;gap:1.2rem}
+header h1{font-size:1.05rem;margin:0}
+nav a{color:#9aa3b2;text-decoration:none;margin-right:.9rem;
+  font-size:.9rem;padding:.15rem 0}
+nav a.active{color:#fff;border-bottom:2px solid #6ba4ff}
+main{padding:1rem 1.2rem}
+h2{font-size:1rem;margin:.8rem 0 .4rem}
+table{border-collapse:collapse;font-size:.83rem;width:100%;background:#fff}
 td,th{border:1px solid #ddd;padding:.25rem .5rem;text-align:left}
-th{background:#eee} code{background:#eee;padding:0 .25rem}
-#err{color:#b00}
+th{background:#eee;position:sticky;top:0}
+tr.clickable{cursor:pointer} tr.clickable:hover{background:#eef4ff}
+code{background:#eee;padding:0 .25rem} #err{color:#b00}
+.cards{display:flex;gap:.8rem;flex-wrap:wrap;margin:.4rem 0 1rem}
+.card{background:#fff;border:1px solid #ddd;border-radius:6px;
+  padding:.5rem .9rem;min-width:7.5rem}
+.card .v{font-size:1.3rem;font-weight:600}
+.card .k{font-size:.75rem;color:#667}
+svg.spark{background:#fff;border:1px solid #ddd;border-radius:4px}
+#detail{background:#fff;border:1px solid #bcd;border-radius:6px;
+  padding:.6rem .9rem;margin:.6rem 0;white-space:pre-wrap;
+  font-family:ui-monospace,monospace;font-size:.8rem;display:none}
 </style></head><body>
-<h1>ray_tpu dashboard</h1>
-<div id="err"></div>
-<div id="sections"></div>
+<header><h1>ray_tpu</h1><nav id="nav"></nav></header>
+<main><div id="err"></div><div id="detail"></div><div id="view"></div></main>
 <script>
-const APIS = ["summary","nodes","actors","tasks","workers",
-              "placement_groups","events"];
+const TABS = ["overview","nodes","actors","tasks","objects","workers",
+  "placement_groups","jobs","events","event_stats"];
+// Client-side metric history for the sparklines (one poll per refresh).
+const hist = {running:[], total:[], load:[], mem:[]};
 function esc(v){
   // API values include user-controlled strings (task/actor names, event
   // messages) — escape before interpolating into innerHTML (stored XSS).
   return String(v).replace(/[&<>"']/g, ch => ({"&":"&amp;","<":"&lt;",
     ">":"&gt;",'"':"&quot;","'":"&#39;"}[ch]));
 }
-function render(name, data){
-  const rows = Array.isArray(data) ? data :
-    Object.entries(data).map(([k,v])=>({key:k,value:JSON.stringify(v)}));
-  if(!rows.length) return `<h2>${esc(name)}</h2><p>(empty)</p>`;
+function spark(values, w=220, h=44, color="#4a7fd4"){
+  if(values.length < 2) return `<svg class="spark" width="${w}" height="${h}"></svg>`;
+  const max = Math.max(...values, 1e-9), min = Math.min(...values, 0);
+  const pts = values.map((v,i)=>
+    `${(i/(values.length-1)*(w-6)+3).toFixed(1)},` +
+    `${(h-4-(v-min)/(max-min||1)*(h-10)).toFixed(1)}`).join(" ");
+  return `<svg class="spark" width="${w}" height="${h}">
+    <polyline fill="none" stroke="${color}" stroke-width="1.5"
+      points="${pts}"/>
+    <text x="${w-4}" y="11" text-anchor="end" font-size="9"
+      fill="#667">${values[values.length-1].toFixed(2)}</text></svg>`;
+}
+function table(rows, opts={}){
+  if(!rows || !rows.length) return "<p>(empty)</p>";
   const cols = Object.keys(rows[0]);
   const head = cols.map(c=>`<th>${esc(c)}</th>`).join("");
-  const body = rows.slice(0,100).map(r=>"<tr>"+cols.map(
-    c=>`<td>${esc(typeof r[c]==="object"?JSON.stringify(r[c]):r[c])}</td>`
-  ).join("")+"</tr>").join("");
-  return `<h2>${esc(name)} (${rows.length})</h2>
-          <table><tr>${head}</tr>${body}</table>`;
+  const body = rows.slice(0, 200).map(r=>{
+    const click = opts.idcol && r[opts.idcol] ?
+      ` class="clickable" data-id="${esc(r[opts.idcol])}"` : "";
+    return `<tr${click}>`+cols.map(c=>`<td>${esc(
+      typeof r[c]==="object" && r[c]!==null?JSON.stringify(r[c]):r[c]
+    )}</td>`).join("")+"</tr>";
+  }).join("");
+  return `<table><tr>${head}</tr>${body}</table>`;
+}
+async function fetchJson(path){
+  const res = await fetch(path);
+  if(!res.ok) throw new Error(path + " -> " + res.status);
+  return res.json();
+}
+async function renderOverview(){
+  const [summary, stats, nodes] = await Promise.all([
+    fetchJson("/api/summary"), fetchJson("/api/node_stats"),
+    fetchJson("/api/nodes")]);
+  const total = Object.values(summary).reduce((a,b)=>a+b,0);
+  hist.running.push(summary.RUNNING||0); hist.total.push(total);
+  hist.load.push(stats.loadavg_1m||0);
+  hist.mem.push(stats.mem_used_frac||0);
+  for(const k in hist) if(hist[k].length>120) hist[k].shift();
+  const cards = [["nodes", nodes.length], ["tasks total", total],
+    ["running", summary.RUNNING||0], ["done", summary.DONE||0],
+    ["load 1m", (stats.loadavg_1m??0).toFixed(2)],
+    ["mem used", ((stats.mem_used_frac??0)*100).toFixed(1)+"%"]]
+    .map(([k,v])=>`<div class="card"><div class="v">${esc(v)}</div>
+      <div class="k">${esc(k)}</div></div>`).join("");
+  return `<div class="cards">${cards}</div>
+    <h2>running tasks</h2>${spark(hist.running)}
+    <h2>host load (1m)</h2>${spark(hist.load, 220, 44, "#d4824a")}
+    <h2>memory used fraction</h2>${spark(hist.mem, 220, 44, "#7a4ad4")}
+    <h2>nodes</h2>${table(nodes)}`;
+}
+async function renderTab(tab){
+  if(tab === "overview") return renderOverview();
+  const data = await fetchJson("/api/"+tab);
+  const rows = Array.isArray(data) ? data :
+    Object.entries(data).map(([k,v])=>({key:k, value:JSON.stringify(v)}));
+  const opts = tab === "actors" ? {idcol: "actor_id"} :
+               tab === "tasks" ? {idcol: "task_id"} : {};
+  let hint = opts.idcol ? "<p style='font-size:.8rem;color:#667'>" +
+    "click a row for details</p>" : "";
+  return `<h2>${esc(tab)} (${rows.length})</h2>${hint}` +
+    table(rows, opts);
+}
+async function showDetail(tab, id){
+  const api = tab === "actors" ? "/api/actor/" : "/api/task/";
+  try{
+    const d = await fetchJson(api + id);
+    const el = document.getElementById("detail");
+    el.style.display = "block";
+    el.textContent = JSON.stringify(d, null, 2);
+  }catch(e){ document.getElementById("err").textContent = String(e); }
+}
+function activeTab(){
+  const t = location.hash.replace("#","");
+  return TABS.includes(t) ? t : "overview";
 }
 async function refresh(){
-  let html = "";
-  for(const api of APIS){
-    try{
-      const res = await fetch("/api/"+api);
-      html += render(api, await res.json());
-    }catch(e){
-      document.getElementById("err").textContent = String(e);
-    }
+  const tab = activeTab();
+  document.getElementById("nav").innerHTML = TABS.map(t=>
+    `<a href="#${t}" class="${t===tab?"active":""}">${t}</a>`).join("");
+  try{
+    document.getElementById("view").innerHTML = await renderTab(tab);
+    document.getElementById("err").textContent = "";
+    document.querySelectorAll("tr.clickable").forEach(tr=>
+      tr.addEventListener("click", ()=>showDetail(tab, tr.dataset.id)));
+  }catch(e){
+    document.getElementById("err").textContent = String(e);
   }
-  document.getElementById("sections").innerHTML = html;
 }
-refresh(); setInterval(refresh, 5000);
+window.addEventListener("hashchange", ()=>{
+  document.getElementById("detail").style.display = "none";
+  refresh();
+});
+refresh(); setInterval(refresh, 4000);
 </script></body></html>"""
 
 
@@ -124,6 +211,8 @@ class Dashboard:
             "/api/summary": state_api.summarize_tasks,
             "/api/events": lambda: global_event_log().query(limit=200),
             "/api/node_stats": node_stats,
+            "/api/jobs": state_api.list_jobs,
+            "/api/event_stats": state_api.event_loop_stats,
         }
 
         class Handler(BaseHTTPRequestHandler):
@@ -157,6 +246,9 @@ class Dashboard:
                 if fn is None and path.startswith("/api/task/"):
                     task_hex = path[len("/api/task/"):]
                     fn = lambda: state_api.task_detail(task_hex)  # noqa: E731
+                if fn is None and path.startswith("/api/actor/"):
+                    actor_hex = path[len("/api/actor/"):]
+                    fn = lambda: state_api.actor_detail(actor_hex)  # noqa: E731
                 if fn is None and path.startswith("/api/logs/"):
                     from urllib.parse import parse_qs, urlparse
 

@@ -1,0 +1,118 @@
+"""Per-handler event-loop statistics.
+
+Reference analog: ``src/ray/common/asio/instrumented_io_context.h`` +
+``event_stats.h`` — every handler posted to a raylet/GCS event loop is
+timed, and ``RAY_event_stats_print_interval_ms`` dumps a table of
+per-handler count / total / mean / max. Here the instrumented "loops"
+are the runtime's worker-message pump, the node daemon's control-message
+handler, and the control-store client ops; stats surface through the
+state API (``event_loop_stats``), the dashboard (``/api/event_stats``),
+and ``rt status -v``.
+
+Recording is one dict update per event under the GIL (a lock guards
+only the aggregate swap in snapshot) — cheap enough for hot dispatch
+paths.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class _HandlerStat:
+    __slots__ = ("count", "total_s", "max_s")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+
+class EventStats:
+    def __init__(self):
+        self._stats: Dict[str, _HandlerStat] = {}
+        self._lock = threading.Lock()
+
+    def record(self, name: str, duration_s: float) -> None:
+        stat = self._stats.get(name)
+        if stat is None:
+            # Rare path; the lock only guards first-insert races.
+            with self._lock:
+                stat = self._stats.setdefault(name, _HandlerStat())
+        stat.count += 1
+        stat.total_s += duration_s
+        if duration_s > stat.max_s:
+            stat.max_s = duration_s
+
+    def measure(self, name: str) -> "_Timer":
+        return _Timer(self, name)
+
+    def snapshot(self, top: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Rows sorted by total time descending (the reference table's
+        ordering — total time is what finds a hot handler)."""
+        rows = []
+        for name, s in list(self._stats.items()):
+            count = s.count
+            if not count:
+                continue
+            rows.append({
+                "handler": name,
+                "count": count,
+                "total_ms": round(s.total_s * 1e3, 3),
+                "mean_us": round(s.total_s / count * 1e6, 1),
+                "max_ms": round(s.max_s * 1e3, 3),
+            })
+        rows.sort(key=lambda r: -r["total_ms"])
+        return rows[:top] if top else rows
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+    def format_table(self, top: int = 20) -> str:
+        rows = self.snapshot(top)
+        if not rows:
+            return "(no events recorded)"
+        w = max(len(r["handler"]) for r in rows)
+        lines = [f"{'handler':<{w}}  {'count':>8}  {'total_ms':>10} "
+                 f"{'mean_us':>9}  {'max_ms':>8}"]
+        for r in rows:
+            lines.append(
+                f"{r['handler']:<{w}}  {r['count']:>8}  "
+                f"{r['total_ms']:>10.3f} {r['mean_us']:>9.1f}  "
+                f"{r['max_ms']:>8.3f}")
+        return "\n".join(lines)
+
+
+class _Timer:
+    __slots__ = ("_stats", "_name", "_t0")
+
+    def __init__(self, stats: EventStats, name: str):
+        self._stats = stats
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._stats.record(self._name,
+                           time.perf_counter() - self._t0)
+        return False
+
+
+_GLOBAL = EventStats()
+
+
+def global_event_stats() -> EventStats:
+    return _GLOBAL
+
+
+def record(name: str, duration_s: float) -> None:
+    _GLOBAL.record(name, duration_s)
+
+
+def measure(name: str) -> _Timer:
+    return _GLOBAL.measure(name)

@@ -52,6 +52,8 @@ def list_tasks(filters: Optional[Dict[str, str]] = None,
             "state": rec.state,
             "resources": dict(rec.spec.resources),
             "node_id": rec.node.node_id.hex() if rec.node else None,
+            "actor_id": (rec.spec.actor_id.hex()
+                         if getattr(rec.spec, "actor_id", None) else None),
         }
         if filters and any(str(row.get(k)) != str(v)
                            for k, v in filters.items()):
@@ -159,6 +161,48 @@ def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:
     return out
 
 
+def actor_detail(actor_id_hex: str) -> Dict[str, Any]:
+    """Per-actor drill-down (reference: the dashboard actor page,
+    ``dashboard/modules/actor``): actor table row + its tasks + the
+    worker hosting it."""
+    rt = _head()
+    info = None
+    for row in rt.gcs.list_actors():
+        if row.actor_id.hex().startswith(actor_id_hex):
+            info = row
+            break
+    if info is None:
+        raise KeyError(f"no actor with id prefix {actor_id_hex!r}")
+    tasks = [t for t in list_tasks()
+             if t.get("actor_id") == info.actor_id.hex()]
+    worker = None
+    if info.worker_id:
+        for w in list_workers():
+            if w["worker_id"] == info.worker_id.hex():
+                worker = w
+                break
+    return {
+        "actor_id": info.actor_id.hex(),
+        "name": info.name,
+        "state": info.state,
+        "node_id": info.node_id.hex() if info.node_id else None,
+        "num_restarts": info.num_restarts,
+        "max_restarts": info.max_restarts,
+        "death_cause": info.death_cause,
+        "tasks": tasks[-50:],
+        "num_tasks": len(tasks),
+        "worker": worker,
+    }
+
+
+def event_loop_stats(top: int = 50) -> List[Dict[str, Any]]:
+    """Per-handler dispatch latency aggregates (reference:
+    event_stats.h GetStatsString)."""
+    from .event_stats import global_event_stats
+
+    return global_event_stats().snapshot(top)
+
+
 def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
     rt = _head()
     out = []
@@ -173,6 +217,22 @@ def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
             "node_id": (loc[1].hex() if loc and loc[0] == "shm" else None),
             "size": (loc[2] if loc and loc[0] == "shm" else None),
             "refcount": rt._refcounts.get(oid, 0),
+        })
+    return out
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    """Driver jobs from the GCS job table (reference: dashboard job
+    module / GcsJobManager)."""
+    rt = _head()
+    out = []
+    for info in rt.gcs.jobs.values():
+        out.append({
+            "job_id": info.job_id.hex(),
+            "status": info.status,
+            "entrypoint": info.entrypoint,
+            "start_time": info.start_time,
+            "end_time": info.end_time,
         })
     return out
 

@@ -22,6 +22,12 @@ def cmd_status(args) -> int:
 
     rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
     print(cluster_status())
+    if getattr(args, "verbose", False):
+        from ray_tpu.observability.event_stats import global_event_stats
+
+        print("\nEvent-loop handler stats "
+              "(reference: event_stats.h table):")
+        print(global_event_stats().format_table())
     return 0
 
 
@@ -217,7 +223,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--resources", default="",
                     help='extra resources JSON, e.g. \'{"TPU": 8}\'')
 
-    sub.add_parser("status", help="cluster resource/task/actor summary")
+    stp = sub.add_parser("status",
+                         help="cluster resource/task/actor summary")
+    stp.add_argument("-v", "--verbose", action="store_true",
+                     help="include per-handler event-loop stats")
     lp = sub.add_parser("list", help="list cluster entities")
     lp.add_argument("entity", choices=["nodes", "tasks", "actors", "objects",
                                        "workers", "placement-groups"])

@@ -1,0 +1,95 @@
+"""Event-loop stats + dashboard drill-down tests
+(reference: src/ray/common/asio/event_stats tests + dashboard modules)."""
+
+import json
+import time
+import urllib.request
+
+import ray_tpu as rt
+from ray_tpu.observability.event_stats import EventStats, \
+    global_event_stats
+
+
+def test_event_stats_aggregation():
+    es = EventStats()
+    es.record("h1", 0.010)
+    es.record("h1", 0.030)
+    es.record("h2", 0.001)
+    with es.measure("h3"):
+        time.sleep(0.005)
+    rows = es.snapshot()
+    assert [r["handler"] for r in rows][0] == "h1"  # most total time
+    h1 = rows[0]
+    assert h1["count"] == 2
+    assert abs(h1["total_ms"] - 40.0) < 1.0
+    assert abs(h1["mean_us"] - 20_000) < 500
+    assert abs(h1["max_ms"] - 30.0) < 1.0
+    h3 = next(r for r in rows if r["handler"] == "h3")
+    assert h3["count"] == 1 and h3["total_ms"] >= 4.0
+    table = es.format_table()
+    assert "h1" in table and "count" in table
+    es.reset()
+    assert es.snapshot() == []
+
+
+def test_runtime_handlers_instrumented(rt_shared):
+    """Task + actor traffic shows up in the global handler table."""
+
+    @rt.remote
+    def f(x):
+        return x + 1
+
+    @rt.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    rt.get([f.remote(i) for i in range(10)])
+    assert rt.get(a.ping.remote()) == "pong"
+    rows = global_event_stats().snapshot()
+    names = {r["handler"] for r in rows}
+    assert any(n.startswith("runtime.worker_msg.") for n in names), names
+    from ray_tpu.observability import event_loop_stats
+
+    api_rows = event_loop_stats(top=5)
+    assert len(api_rows) <= 5
+    assert api_rows == sorted(api_rows, key=lambda r: -r["total_ms"])
+
+
+def test_dashboard_new_routes(rt_shared):
+    from ray_tpu.observability.dashboard import Dashboard
+
+    @rt.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    rt.get(c.inc.remote())
+
+    dash = Dashboard(port=18377).start()
+    try:
+        base = "http://127.0.0.1:18377"
+        with urllib.request.urlopen(f"{base}/api/event_stats") as r:
+            stats = json.loads(r.read())
+        assert isinstance(stats, list) and stats
+        with urllib.request.urlopen(f"{base}/api/jobs") as r:
+            json.loads(r.read())
+        with urllib.request.urlopen(f"{base}/api/actors") as r:
+            actors = json.loads(r.read())
+        assert actors
+        aid = actors[-1]["actor_id"]
+        with urllib.request.urlopen(f"{base}/api/actor/{aid}") as r:
+            detail = json.loads(r.read())
+        assert detail["actor_id"] == aid
+        assert detail["state"] in ("ALIVE", "RUNNING", "STARTED")
+        with urllib.request.urlopen(base + "/") as r:
+            html = r.read().decode()
+        assert "event_stats" in html and "overview" in html
+    finally:
+        dash.stop()
