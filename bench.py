@@ -239,6 +239,11 @@ def bench_core() -> dict:
                 out[key + "_vs_memcpy"] = row["vs_memcpy"]
         else:
             out[key] = row["ops_per_s"]
+        if "window_spread" in row:
+            # Median-of-5-windows measurement: spread = (max-min)/median
+            # across the windows, so a swingy host is visible in the
+            # result instead of silently biasing it.
+            out[key + "_spread"] = row["window_spread"]
     return out
 
 

@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..observability import event_stats as _event_stats
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _NATIVE_DIR = os.path.join(os.path.dirname(_HERE), "_native")
 _BINARY = os.path.join(_NATIVE_DIR, "build", "control_store")
@@ -117,18 +119,13 @@ class ControlStoreClient:
 
     # -- wire -------------------------------------------------------------
     def _call(self, op: int, body: bytes = b"") -> _FrameReader:
-        import time as _time
-
         frame = bytes([op]) + body
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         with self._lock:
             self._sock.sendall(struct.pack("<I", len(frame)) + frame)
             reply = _recv_frame(self._sock)
-        from ..observability import event_stats
-
-        event_stats.record(
-            f"control_store.{_OP_NAMES.get(op, op)}",
-            _time.perf_counter() - t0)
+        _event_stats.record(f"control_store.{_OP_NAMES.get(op, op)}",
+                            time.perf_counter() - t0)
         r = _FrameReader(reply)
         status = r.u8()
         if status == ST_ERR:

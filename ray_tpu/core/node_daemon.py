@@ -37,6 +37,7 @@ from .ids import NodeID, WorkerID
 from .node_protocol import ChunkAssembler, FrameConn, chunk_frames
 from .object_store import SharedMemoryStore
 from .worker_pool import WorkerPool
+from ..observability import event_stats as _event_stats
 
 
 class NodeDaemon:
@@ -131,9 +132,7 @@ class NodeDaemon:
             self.shutdown()
 
     def _handle(self, msg: tuple) -> None:
-        from ..observability import event_stats
-
-        with event_stats.measure(f"daemon.{msg[0]}"):
+        with _event_stats.measure(f"daemon.{msg[0]}"):
             self._handle_impl(msg)
 
     def _handle_impl(self, msg: tuple) -> None:
@@ -201,6 +200,12 @@ class NodeDaemon:
         elif kind == "store_stats":
             _, req_id = msg
             self.conn.send(("reply", req_id, True, self.store.stats()))
+        elif kind == "event_stats":
+            # The daemon's handler stats live in THIS process's global;
+            # the head aggregates them per node for the state API.
+            _, req_id = msg
+            self.conn.send(("reply", req_id, True,
+                            _event_stats.global_event_stats().snapshot()))
         elif kind == "locate_reply":
             _, req_id, ok, payload = msg
             with self._locate_lock:

@@ -362,6 +362,13 @@ class RemoteNode:
 
     is_remote = True
 
+    def event_stats(self) -> list:
+        """The daemon process's per-handler event-loop stats
+        (reference: each raylet's instrumented_io_context is
+        per-process; the dashboard aggregates across nodes)."""
+        return self.conn.request(
+            lambda req_id: [("event_stats", req_id)], timeout=5.0)
+
     def __init__(self, node_id: NodeID, resources: Dict[str, float],
                  message_handler: Callable, on_worker_death: Callable,
                  on_node_death: Callable,

@@ -42,6 +42,7 @@ from .object_store import MemoryStore
 from .scheduler import ClusterScheduler, NodeManager, PendingLease
 from .serialization import Serializer
 from .task_spec import SchedulingStrategy, TaskSpec, TaskType
+from ..observability import event_stats as _event_stats
 from .worker_pool import WorkerHandle
 
 
@@ -1243,9 +1244,7 @@ class Runtime:
         # Instrumented like the reference's event loops
         # (asio/instrumented_io_context.h): per-kind latency/count
         # aggregates surface via the state API and `rt status -v`.
-        from ..observability import event_stats
-
-        with event_stats.measure(f"runtime.worker_msg.{msg[0]}"):
+        with _event_stats.measure(f"runtime.worker_msg.{msg[0]}"):
             self._handle_worker_message_impl(worker, msg)
 
     def _handle_worker_message_impl(self, worker: WorkerHandle,
@@ -1545,9 +1544,7 @@ class Runtime:
         try_finish(False)
 
     def _handle_worker_rpc(self, worker: WorkerHandle, msg: tuple) -> None:
-        from ..observability import event_stats
-
-        with event_stats.measure(f"runtime.worker_rpc.{msg[0]}"):
+        with _event_stats.measure(f"runtime.worker_rpc.{msg[0]}"):
             self._handle_worker_rpc_impl(worker, msg)
 
     def _handle_worker_rpc_impl(self, worker: WorkerHandle,

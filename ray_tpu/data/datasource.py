@@ -75,9 +75,9 @@ class Datasource:
                 and meta_provider is None):
             return self.expand_paths(paths)  # legacy flat listing
         mp = meta_provider or DefaultFileMetadataProvider()
-        # The format's extension filter goes per-call (a provider whose
-        # own file_extensions is set wins only when the call passes
-        # none) so a caller's shared provider is never mutated.
+        # The format's extension filter goes per-call, and a provider's
+        # own file_extensions (caller-configured) takes precedence — a
+        # caller's shared provider is never mutated or overridden.
         files = mp.expand_paths(
             paths, file_extensions=self.FILE_EXTENSIONS)
         if partition_filter is not None:

@@ -204,8 +204,12 @@ class DefaultFileMetadataProvider(FileMetadataProvider):
                 out.append(p)
             else:
                 raise FileNotFoundError(p)
-        exts = (file_extensions if file_extensions is not None
-                else self.file_extensions)
+        # Instance setting wins: a caller who configured their provider
+        # (or left it unfiltered on purpose) keeps that behavior; the
+        # per-call value is the DATASOURCE's default for providers that
+        # didn't specify one.
+        exts = (self.file_extensions if self.file_extensions is not None
+                else file_extensions)
         if exts:
             out = [p for p in out if p.lower().endswith(tuple(exts))]
         if not out:

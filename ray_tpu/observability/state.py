@@ -196,11 +196,32 @@ def actor_detail(actor_id_hex: str) -> Dict[str, Any]:
 
 
 def event_loop_stats(top: int = 50) -> List[Dict[str, Any]]:
-    """Per-handler dispatch latency aggregates (reference:
-    event_stats.h GetStatsString)."""
+    """Per-handler dispatch latency aggregates, aggregated across the
+    head process AND every node-daemon process (reference:
+    event_stats.h GetStatsString; each raylet's loop is per-process).
+    Daemon rows carry a ``node`` column; unreachable daemons are
+    skipped rather than failing the whole listing."""
     from .event_stats import global_event_stats
 
-    return global_event_stats().snapshot(top)
+    rows = global_event_stats().snapshot(top)
+    for r in rows:
+        r["node"] = "head"
+    try:
+        rt = _head()
+        for node in rt.scheduler.nodes():
+            fetch = getattr(node, "event_stats", None)
+            if fetch is None or not getattr(node, "alive", True):
+                continue
+            try:
+                for r in fetch():
+                    r["node"] = node.node_id.hex()[:8]
+                    rows.append(r)
+            except Exception:
+                continue
+    except Exception:
+        pass
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows[:top] if top else rows
 
 
 def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
