@@ -26,12 +26,22 @@ from .command_runner import (
     SSHCommandRunner,
     SubprocessCommandRunner,
 )
+from .kube_operator import (
+    KubeRayNodeProvider,
+    KubectlAPI,
+    MockKubeAPI,
+    RayClusterOperator,
+    RayClusterSpec,
+    WorkerGroupSpec,
+)
 from .providers import FakeNodeProvider, LocalNodeProvider, NodeProvider
 
 __all__ = [
     "AutoscalerConfig", "CommandRunner", "CommandRunnerError",
-    "FakeNodeProvider", "LoadMetrics",
-    "LocalNodeProvider", "NodeProvider", "NodeType", "NodeUpdater",
+    "FakeNodeProvider", "KubeRayNodeProvider", "KubectlAPI",
+    "LoadMetrics",
+    "LocalNodeProvider", "MockKubeAPI", "NodeProvider", "NodeType",
+    "NodeUpdater", "RayClusterOperator", "RayClusterSpec",
     "ResourceDemandScheduler", "SSHCommandRunner", "StandardAutoscaler",
-    "SubprocessCommandRunner",
+    "SubprocessCommandRunner", "WorkerGroupSpec",
 ]

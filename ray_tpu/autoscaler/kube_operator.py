@@ -1,0 +1,354 @@
+"""Kubernetes operator: RayCluster-style custom resources reconciled
+into pods.
+
+Reference analog: the KubeRay operator shipped with the reference
+ecosystem (``python/ray/autoscaler/_private/kuberay/`` — node provider
+speaking to the operator's RayCluster CRD, plus the operator's own
+reconcile loop): a declarative cluster spec (head + worker groups) is
+continuously reconciled against observed pod state — create missing
+pods, delete surplus, replace crashed heads, surface status.
+
+The Kubernetes API itself is abstracted behind :class:`KubeAPI`:
+``MockKubeAPI`` (in-memory pods with optional chaos) drives tests and
+the autoscaler-style E2E; ``KubectlAPI`` shells out to ``kubectl`` when
+present and fails with an actionable error here (no cluster in this
+environment). The operator also exposes a :class:`NodeProvider` facade
+so the StandardAutoscaler can scale worker groups through the same CRD
+path (the KubeRay arrangement: autoscaler edits replicas, operator
+reconciles pods).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .providers import NodeInstance, NodeProvider
+
+
+@dataclass
+class WorkerGroupSpec:
+    """One homogeneous worker group (KubeRay workerGroupSpecs entry)."""
+
+    group_name: str
+    replicas: int = 1
+    min_replicas: int = 0
+    max_replicas: int = 10
+    resources: Dict[str, float] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class RayClusterSpec:
+    """The RayCluster custom resource (KubeRay CRD shape, trimmed)."""
+
+    name: str
+    head_resources: Dict[str, float] = field(
+        default_factory=lambda: {"CPU": 1.0})
+    worker_groups: List[WorkerGroupSpec] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(doc: Dict) -> "RayClusterSpec":
+        """Parse the YAML/JSON document shape KubeRay uses::
+
+            apiVersion: ray.io/v1
+            kind: RayCluster
+            metadata: {name: demo}
+            spec:
+              headGroupSpec: {resources: {CPU: 2}}
+              workerGroupSpecs:
+                - groupName: cpu
+                  replicas: 2
+                  minReplicas: 0
+                  maxReplicas: 8
+                  resources: {CPU: 4}
+        """
+        if doc.get("kind") != "RayCluster":
+            raise ValueError(
+                f"expected kind: RayCluster, got {doc.get('kind')!r}")
+        spec = doc.get("spec", {})
+        groups = []
+        for g in spec.get("workerGroupSpecs", []):
+            groups.append(WorkerGroupSpec(
+                group_name=g["groupName"],
+                replicas=int(g.get("replicas", 1)),
+                min_replicas=int(g.get("minReplicas", 0)),
+                max_replicas=int(g.get("maxReplicas", 10)),
+                resources=dict(g.get("resources", {})),
+                labels=dict(g.get("labels", {})),
+            ))
+        return RayClusterSpec(
+            name=doc.get("metadata", {}).get("name", "raycluster"),
+            head_resources=dict(
+                spec.get("headGroupSpec", {}).get("resources",
+                                                  {"CPU": 1.0})),
+            worker_groups=groups,
+        )
+
+
+@dataclass
+class Pod:
+    name: str
+    role: str                  # "head" | "worker"
+    group: Optional[str]
+    phase: str = "Pending"     # Pending | Running | Failed | Terminating
+    labels: Dict[str, str] = field(default_factory=dict)
+    resources: Dict[str, float] = field(default_factory=dict)
+    created_at: float = field(default_factory=time.time)
+
+
+class KubeAPI:
+    """The 4 pod verbs the operator needs (CoreV1 subset)."""
+
+    def list_pods(self, selector: Dict[str, str]) -> List[Pod]:
+        raise NotImplementedError
+
+    def create_pod(self, pod: Pod) -> Pod:
+        raise NotImplementedError
+
+    def delete_pod(self, name: str) -> None:
+        raise NotImplementedError
+
+    def pod_phase(self, name: str) -> Optional[str]:
+        raise NotImplementedError
+
+
+class MockKubeAPI(KubeAPI):
+    """In-memory pod store: created pods turn Running after
+    ``ready_after`` polls (scheduling latency); test chaos via
+    :meth:`fail_pod`."""
+
+    def __init__(self, ready_after: int = 0):
+        self._pods: Dict[str, Pod] = {}
+        self._polls: Dict[str, int] = {}
+        self.ready_after = ready_after
+        self._lock = threading.Lock()
+
+    def list_pods(self, selector: Dict[str, str]) -> List[Pod]:
+        with self._lock:
+            out = []
+            for pod in self._pods.values():
+                if all(pod.labels.get(k) == v
+                       for k, v in selector.items()):
+                    self._advance(pod)
+                    out.append(copy.deepcopy(pod))
+            return out
+
+    def _advance(self, pod: Pod) -> None:
+        if pod.phase == "Pending":
+            n = self._polls.get(pod.name, 0) + 1
+            self._polls[pod.name] = n
+            if n > self.ready_after:
+                pod.phase = "Running"
+
+    def create_pod(self, pod: Pod) -> Pod:
+        with self._lock:
+            if pod.name in self._pods:
+                raise ValueError(f"pod {pod.name} exists")
+            self._pods[pod.name] = copy.deepcopy(pod)
+            return pod
+
+    def delete_pod(self, name: str) -> None:
+        with self._lock:
+            self._pods.pop(name, None)
+            self._polls.pop(name, None)
+
+    def pod_phase(self, name: str) -> Optional[str]:
+        with self._lock:
+            pod = self._pods.get(name)
+            return pod.phase if pod else None
+
+    def fail_pod(self, name: str) -> None:
+        with self._lock:
+            if name in self._pods:
+                self._pods[name].phase = "Failed"
+
+
+class KubectlAPI(KubeAPI):
+    """Real-cluster path via kubectl; declared-but-gated here
+    (no Kubernetes control plane in this environment)."""
+
+    def __init__(self, namespace: str = "default"):
+        import shutil
+
+        if shutil.which("kubectl") is None:
+            raise RuntimeError(
+                "KubectlAPI needs kubectl on PATH; none found in this "
+                "environment — use MockKubeAPI for tests or run the "
+                "operator inside a cluster")
+        self.namespace = namespace  # pragma: no cover - needs a cluster
+
+
+class RayClusterOperator:
+    """The reconcile loop (KubeRay raycluster_controller logic):
+
+    observe pods -> compare against spec -> converge:
+      * no Running/Pending head  -> create head pod (crash replacement)
+      * group below replicas     -> create worker pods
+      * group above replicas     -> delete newest surplus pods
+      * Failed pods              -> delete (next pass recreates)
+    One reconcile() call is one idempotent pass; run() loops it.
+    """
+
+    def __init__(self, api: KubeAPI, spec: RayClusterSpec,
+                 poll_interval_s: float = 1.0):
+        self.api = api
+        self.spec = spec
+        self.poll_interval_s = poll_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.events: List[str] = []
+
+    # -- selectors ---------------------------------------------------------
+    def _selector(self) -> Dict[str, str]:
+        return {"ray.io/cluster": self.spec.name}
+
+    def _base_labels(self, role: str, group: Optional[str]
+                     ) -> Dict[str, str]:
+        labels = {"ray.io/cluster": self.spec.name, "ray.io/role": role}
+        if group:
+            labels["ray.io/group"] = group
+        return labels
+
+    def _log(self, msg: str) -> None:
+        self.events.append(msg)
+
+    # -- reconcile ---------------------------------------------------------
+    def reconcile(self) -> Dict[str, Any]:
+        pods = self.api.list_pods(self._selector())
+        # Failed pods are deleted this pass; replacements appear next
+        # pass (KubeRay does the same two-phase replacement).
+        for pod in [p for p in pods if p.phase == "Failed"]:
+            self._log(f"delete failed pod {pod.name}")
+            self.api.delete_pod(pod.name)
+        pods = [p for p in pods if p.phase != "Failed"]
+
+        heads = [p for p in pods if p.role == "head"]
+        if not heads:
+            name = f"{self.spec.name}-head-{uuid.uuid4().hex[:6]}"
+            self._log(f"create head pod {name}")
+            self.api.create_pod(Pod(
+                name=name, role="head", group=None,
+                labels=self._base_labels("head", None),
+                resources=dict(self.spec.head_resources)))
+
+        for group in self.spec.worker_groups:
+            members = sorted(
+                (p for p in pods
+                 if p.role == "worker" and p.group == group.group_name),
+                key=lambda p: p.created_at)
+            want = max(group.min_replicas,
+                       min(group.replicas, group.max_replicas))
+            for _ in range(want - len(members)):
+                name = (f"{self.spec.name}-{group.group_name}-"
+                        f"{uuid.uuid4().hex[:6]}")
+                self._log(f"create worker pod {name}")
+                self.api.create_pod(Pod(
+                    name=name, role="worker", group=group.group_name,
+                    labels=self._base_labels("worker", group.group_name),
+                    resources=dict(group.resources)))
+            for pod in members[want:] if want < len(members) else []:
+                self._log(f"scale down: delete {pod.name}")
+                self.api.delete_pod(pod.name)
+        return self.status()
+
+    def status(self) -> Dict[str, Any]:
+        """The CRD's status subresource (KubeRay state/ready counts)."""
+        pods = self.api.list_pods(self._selector())
+        heads = [p for p in pods if p.role == "head"]
+        groups = {}
+        for g in self.spec.worker_groups:
+            members = [p for p in pods if p.group == g.group_name]
+            groups[g.group_name] = {
+                "desired": g.replicas,
+                "ready": sum(1 for p in members
+                             if p.phase == "Running"),
+                "pending": sum(1 for p in members
+                               if p.phase == "Pending"),
+            }
+        head_ready = any(p.phase == "Running" for p in heads)
+        all_ready = head_ready and all(
+            v["ready"] >= min(g.replicas, g.max_replicas)
+            for g, v in zip(self.spec.worker_groups, groups.values()))
+        return {
+            "state": "ready" if all_ready else "reconciling",
+            "head": {"ready": head_ready},
+            "worker_groups": groups,
+            "num_pods": len(pods),
+        }
+
+    def scale_group(self, group_name: str, replicas: int) -> None:
+        """Edit the CRD's replicas (what the autoscaler patches)."""
+        for g in self.spec.worker_groups:
+            if g.group_name == group_name:
+                g.replicas = max(g.min_replicas,
+                                 min(replicas, g.max_replicas))
+                return
+        raise KeyError(f"no worker group {group_name!r}")
+
+    # -- background loop ---------------------------------------------------
+    def run(self) -> "RayClusterOperator":
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.reconcile()
+                except Exception as e:  # noqa: BLE001 - keep looping
+                    self._log(f"reconcile error: {e!r}")
+                self._stop.wait(self.poll_interval_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="rt-kube-operator")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class KubeRayNodeProvider(NodeProvider):
+    """Autoscaler-facing facade: nodes are worker pods; create/terminate
+    become CRD replica edits that the operator reconciles (the KubeRay
+    node provider pattern — the autoscaler never touches pods
+    directly)."""
+
+    def __init__(self, operator: RayClusterOperator):
+        self.operator = operator
+
+    def _group(self, node_type: str) -> WorkerGroupSpec:
+        for g in self.operator.spec.worker_groups:
+            if g.group_name == node_type:
+                return g
+        raise KeyError(f"no worker group {node_type!r}")
+
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        pods = self.operator.api.list_pods(self.operator._selector())
+        return [
+            NodeInstance(node_id=p.name, node_type=p.group or "head",
+                         tags=dict(p.labels),
+                         running=(p.phase == "Running"))
+            for p in pods if p.role == "worker"
+        ]
+
+    def create_node(self, node_type: str, count: int = 1) -> List[str]:
+        g = self._group(node_type)
+        self.operator.scale_group(node_type, g.replicas + count)
+        self.operator.reconcile()
+        pods = self.operator.api.list_pods(self.operator._selector())
+        members = sorted((p for p in pods if p.group == node_type),
+                         key=lambda p: p.created_at)
+        return [p.name for p in members[-count:]]
+
+    def terminate_node(self, node_id: str) -> None:
+        pods = self.operator.api.list_pods(self.operator._selector())
+        for p in pods:
+            if p.name == node_id and p.group:
+                g = self._group(p.group)
+                self.operator.scale_group(p.group, g.replicas - 1)
+                self.operator.api.delete_pod(node_id)
+                return

@@ -552,6 +552,28 @@ class Dataset:
         return (f"Dataset(num_blocks={self.num_blocks()}, "
                 f"count~{self.count()})")
 
+    def _repr_html_(self) -> str:
+        """Notebook widget: schema table + sample rows (reference:
+        ray.widgets / Dataset._repr_html_ — a static render here, no
+        ipywidgets dependency)."""
+        import html as _html
+
+        schema = self.schema()
+        head = ""
+        if isinstance(schema, dict):
+            head = "".join(
+                f"<tr><td><b>{_html.escape(str(k))}</b></td>"
+                f"<td>{_html.escape(str(v))}</td></tr>"
+                for k, v in schema.items())
+            head = ("<table><tr><th>column</th><th>type</th></tr>"
+                    f"{head}</table>")
+        sample = "".join(
+            f"<li><code>{_html.escape(repr(r)[:200])}</code></li>"
+            for r in self.take(5))
+        return (f"<div><b>Dataset</b>: {self.num_blocks()} blocks, "
+                f"~{self.count()} rows{head}"
+                f"<ul>{sample}</ul></div>")
+
 
 class GroupedData:
     """Reference: grouped_dataset.py — groupby + aggregate, executed as a

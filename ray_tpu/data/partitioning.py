@@ -159,10 +159,11 @@ class FileMetadataProvider:
     """Expands read paths and supplies per-file metadata
     (reference: file_meta_provider.py:22)."""
 
-    #: extensions this expansion keeps (None = keep everything). The
-    #: reading datasource passes its format's extensions per call
-    #: (``file_extensions=``), which takes precedence so a shared
-    #: provider instance never needs mutating.
+    #: Extension filter contract: an INSTANCE setting wins over the
+    #: per-call value (the reading datasource passes its format's
+    #: extensions per call as a default for unconfigured providers).
+    #: None = no preference (datasource default applies); an empty
+    #: tuple () = explicitly unfiltered — keep every file.
     file_extensions: Optional[Tuple[str, ...]] = None
 
     def expand_paths(self, paths, *, recursive: bool = True,
@@ -204,10 +205,8 @@ class DefaultFileMetadataProvider(FileMetadataProvider):
                 out.append(p)
             else:
                 raise FileNotFoundError(p)
-        # Instance setting wins: a caller who configured their provider
-        # (or left it unfiltered on purpose) keeps that behavior; the
-        # per-call value is the DATASOURCE's default for providers that
-        # didn't specify one.
+        # Instance setting wins (incl. the explicit-unfiltered () case);
+        # None defers to the per-call datasource default.
         exts = (self.file_extensions if self.file_extensions is not None
                 else file_extensions)
         if exts:

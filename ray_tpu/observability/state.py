@@ -208,16 +208,30 @@ def event_loop_stats(top: int = 50) -> List[Dict[str, Any]]:
         r["node"] = "head"
     try:
         rt = _head()
-        for node in rt.scheduler.nodes():
-            fetch = getattr(node, "event_stats", None)
-            if fetch is None or not getattr(node, "alive", True):
-                continue
+        nodes = [n for n in rt.scheduler.nodes()
+                 if getattr(n, "event_stats", None) is not None
+                 and getattr(n, "alive", True)]
+        if nodes:
+            # Concurrent fetches: one wedged daemon must cost ONE
+            # timeout, not timeout x num_nodes, on a path the dashboard
+            # polls every few seconds.
+            from concurrent.futures import ThreadPoolExecutor
+
+            ex = ThreadPoolExecutor(max_workers=min(8, len(nodes)))
             try:
-                for r in fetch():
-                    r["node"] = node.node_id.hex()[:8]
-                    rows.append(r)
-            except Exception:
-                continue
+                futs = {ex.submit(n.event_stats): n for n in nodes}
+                for fut, node in futs.items():
+                    try:
+                        for r in fut.result(timeout=3.0):
+                            r["node"] = node.node_id.hex()[:8]
+                            rows.append(r)
+                    except Exception:
+                        continue
+            finally:
+                # wait=False: a hung daemon fetch must not stall this
+                # (dashboard-polled) call at executor teardown either —
+                # the stragglers die with their daemon threads.
+                ex.shutdown(wait=False)
     except Exception:
         pass
     rows.sort(key=lambda r: -r["total_ms"])

@@ -134,6 +134,25 @@ class ResultGrid:
     def errors(self) -> List[str]:
         return [t.error for t in self.trials if t.error]
 
+    def _repr_html_(self) -> str:
+        """Notebook widget: one row per trial with config + last
+        metrics (reference: ResultGrid._repr_html_)."""
+        import html as _html
+
+        rows = []
+        for t in self.trials:
+            metrics = {k: v for k, v in (t.last_result or {}).items()
+                       if isinstance(v, (int, float))}
+            cfg = _html.escape(str(t.config)[:120])
+            ms = _html.escape(", ".join(
+                f"{k}={v:.4g}" for k, v in list(metrics.items())[:6]))
+            rows.append(f"<tr><td>{_html.escape(t.trial_id)}</td>"
+                        f"<td>{_html.escape(t.status)}</td>"
+                        f"<td><code>{cfg}</code></td><td>{ms}</td></tr>")
+        return ("<table><tr><th>trial</th><th>status</th><th>config"
+                "</th><th>last result</th></tr>" + "".join(rows)
+                + "</table>")
+
 
 class TrialRunner:
     """The experiment step loop (trial_runner.py:864)."""

@@ -1,6 +1,8 @@
 """Utility components (reference: ``python/ray/util``)."""
 
 from .actor_pool import ActorPool
+from .dask_backend import enable_dask, ray_tpu_dask_get
 from .queue import Empty, Full, Queue
 
-__all__ = ["ActorPool", "Empty", "Full", "Queue"]
+__all__ = ["ActorPool", "Empty", "Full", "Queue", "enable_dask",
+           "ray_tpu_dask_get"]

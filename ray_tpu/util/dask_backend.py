@@ -1,0 +1,127 @@
+"""Dask-on-ray_tpu: a dask-protocol graph scheduler over the task layer.
+
+Reference analog: ``python/ray/util/dask/scheduler.py`` —
+``ray_dask_get`` walks a dask graph dict and submits one Ray task per
+graph node, with dependencies passed as ObjectRefs so the cluster (not
+the driver) holds every intermediate.
+
+The dask *graph protocol* is plain data (`{key: task}` where a task is
+a tuple ``(callable, *args)``, keys reference other entries, and lists
+recurse — see ``dask/core.py``), so this scheduler neither imports nor
+requires dask: any protocol-shaped graph executes, and when dask IS
+installed, ``dask.compute(x, scheduler=ray_tpu_dask_get)`` plugs in
+directly (``enable_dask()`` registers it as the global default).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Hashable, List, Tuple
+
+from ..core import get, remote
+
+
+def _is_task(x: Any) -> bool:
+    return isinstance(x, tuple) and len(x) > 0 and callable(x[0])
+
+
+def _dependencies(expr: Any, dsk: Dict) -> set:
+    """Keys of dsk referenced inside expr (dask.core.get_dependencies)."""
+    out: set = set()
+    stack = [expr]
+    while stack:
+        e = stack.pop()
+        # Key check comes FIRST: dask keys may themselves be tuples
+        # (dask.array block ids like ("chunk", 0)), which would
+        # otherwise fall into the container-recurse branch.
+        if isinstance(e, Hashable) and not _is_task(e):
+            try:
+                if e in dsk:
+                    out.add(e)
+                    continue
+            except TypeError:
+                pass
+        if _is_task(e):
+            stack.extend(e[1:])
+        elif isinstance(e, (list, tuple)):
+            stack.extend(e)
+    return out
+
+
+def _execute_node(expr, dep_keys: List, *dep_values):
+    """Worker-side: rebuild the node expression with dependency VALUES
+    substituted for their keys, then evaluate it (dask.core.subs+_execute_task
+    semantics)."""
+    env = dict(zip(dep_keys, dep_values))
+
+    def ev(e):
+        # Key substitution first — tuple keys beat container recursion
+        # (same ordering rule as _dependencies).
+        if isinstance(e, Hashable) and not _is_task(e):
+            try:
+                if e in env:
+                    return env[e]
+            except TypeError:
+                pass
+        if _is_task(e):
+            fn = e[0]
+            return fn(*[ev(a) for a in e[1:]])
+        if isinstance(e, list):
+            return [ev(x) for x in e]
+        if isinstance(e, tuple):
+            return tuple(ev(x) for x in e)
+        return e
+    return ev(expr)
+
+
+_exec_remote = None
+
+
+def ray_tpu_dask_get(dsk: Dict, keys, **kwargs):
+    """Dask scheduler entrypoint: ``get(dsk, keys)``.
+
+    Submits one task per graph node in topological order; each node's
+    dependencies arrive as ObjectRefs (resolved by the runtime at
+    dispatch), so intermediates live in the object store and independent
+    branches run in parallel. Returns materialized values with the same
+    nesting as ``keys`` (the dask ``get`` contract).
+    """
+    global _exec_remote
+    if _exec_remote is None:
+        _exec_remote = remote(_execute_node)
+
+    refs: Dict[Any, Any] = {}
+
+    def build(key, stack=()):  # DFS with cycle detection
+        if key in refs:
+            return refs[key]
+        if key in stack:
+            raise ValueError(f"cycle in dask graph at {key!r}")
+        expr = dsk[key]
+        deps = sorted(_dependencies(expr, dsk), key=str)
+        dep_refs = [build(d, stack + (key,)) for d in deps]
+        refs[key] = _exec_remote.remote(expr, deps, *dep_refs)
+        return refs[key]
+
+    def resolve(k):
+        if isinstance(k, list):
+            return [resolve(x) for x in k]
+        if k not in dsk:
+            raise KeyError(f"key {k!r} not in graph")
+        return get(build(k))
+
+    if isinstance(keys, list):
+        return [resolve(k) for k in keys]
+    return resolve(keys)
+
+
+def enable_dask() -> None:
+    """Install as dask's default scheduler (reference:
+    ``ray.util.dask.enable_dask_on_ray``). Requires dask."""
+    try:
+        import dask
+    except ImportError as e:
+        raise ImportError(
+            "enable_dask() needs the dask package (not installed in "
+            "this environment); ray_tpu_dask_get still executes "
+            "protocol-shaped graph dicts directly") from e
+    dask.config.set(scheduler=ray_tpu_dask_get)

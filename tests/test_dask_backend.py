@@ -1,0 +1,63 @@
+"""Dask-protocol scheduler tests (reference: ray/util/dask tests).
+
+dask itself is not installed here; the graphs below are hand-built to
+the dask graph spec (dict of key -> (callable, *args) with key
+references), which is exactly what dask.compute hands a scheduler."""
+
+import operator
+
+import pytest
+
+from ray_tpu.util.dask_backend import enable_dask, ray_tpu_dask_get
+
+
+def test_simple_chain(rt_shared):
+    dsk = {
+        "x": 1,
+        "y": (operator.add, "x", 10),
+        "z": (operator.mul, "y", "y"),
+    }
+    assert ray_tpu_dask_get(dsk, "z") == 121
+    assert ray_tpu_dask_get(dsk, ["z", "y"]) == [121, 11]
+    assert ray_tpu_dask_get(dsk, [["z"], ["x", "y"]]) == [[121], [1, 11]]
+
+
+def test_parallel_branches_and_tuple_keys(rt_shared):
+    # dask.array-style tuple keys + tree reduction.
+    dsk = {("chunk", i): (operator.mul, i, i) for i in range(8)}
+    dsk["sum"] = (sum, [("chunk", i) for i in range(8)])
+    assert ray_tpu_dask_get(dsk, "sum") == sum(i * i for i in range(8))
+
+
+def test_nested_task_expressions(rt_shared):
+    dsk = {
+        "a": 3,
+        "b": (operator.add, (operator.mul, "a", 2), 1),  # nested task
+        "c": (list, (range, "a")),
+    }
+    assert ray_tpu_dask_get(dsk, "b") == 7
+    assert ray_tpu_dask_get(dsk, "c") == [0, 1, 2]
+
+
+def test_literals_pass_through(rt_shared):
+    dsk = {"k": (operator.add, "not-a-key", "!")}
+    # "not-a-key" is not in the graph: treated as a literal string.
+    assert ray_tpu_dask_get(dsk, "k") == "not-a-key!"
+
+
+def test_errors(rt_shared):
+    with pytest.raises(KeyError, match="missing"):
+        ray_tpu_dask_get({"a": 1}, "missing")
+    dsk = {"a": (operator.add, "b", 1), "b": (operator.add, "a", 1)}
+    with pytest.raises(ValueError, match="cycle"):
+        ray_tpu_dask_get(dsk, "a")
+
+
+def test_enable_dask_gated():
+    try:
+        import dask  # noqa: F401
+    except ImportError:
+        with pytest.raises(ImportError, match="dask"):
+            enable_dask()
+    else:
+        enable_dask()

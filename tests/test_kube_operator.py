@@ -1,0 +1,161 @@
+"""RayCluster operator tests (reference: KubeRay raycluster_controller
+reconcile behavior + the kuberay node provider)."""
+
+import pytest
+
+from ray_tpu.autoscaler.kube_operator import (
+    KubeRayNodeProvider,
+    KubectlAPI,
+    MockKubeAPI,
+    Pod,
+    RayClusterOperator,
+    RayClusterSpec,
+    WorkerGroupSpec,
+)
+
+
+def _spec(replicas=2):
+    return RayClusterSpec(
+        name="demo",
+        head_resources={"CPU": 2.0},
+        worker_groups=[WorkerGroupSpec("cpu", replicas=replicas,
+                                       min_replicas=0, max_replicas=4,
+                                       resources={"CPU": 4.0})],
+    )
+
+
+def test_crd_parse_from_dict():
+    doc = {
+        "apiVersion": "ray.io/v1",
+        "kind": "RayCluster",
+        "metadata": {"name": "parsed"},
+        "spec": {
+            "headGroupSpec": {"resources": {"CPU": 2}},
+            "workerGroupSpecs": [
+                {"groupName": "cpu", "replicas": 3, "minReplicas": 1,
+                 "maxReplicas": 8, "resources": {"CPU": 4}},
+                {"groupName": "tpu", "replicas": 1,
+                 "resources": {"TPU": 8}},
+            ],
+        },
+    }
+    spec = RayClusterSpec.from_dict(doc)
+    assert spec.name == "parsed"
+    assert [g.group_name for g in spec.worker_groups] == ["cpu", "tpu"]
+    assert spec.worker_groups[0].max_replicas == 8
+    with pytest.raises(ValueError, match="RayCluster"):
+        RayClusterSpec.from_dict({"kind": "Deployment"})
+
+
+def test_reconcile_converges_to_spec():
+    api = MockKubeAPI(ready_after=1)
+    op = RayClusterOperator(api, _spec(replicas=2))
+    st = op.reconcile()
+    assert st["num_pods"] == 3  # 1 head + 2 workers
+    assert st["state"] == "reconciling"  # pods still Pending
+    op.reconcile()
+    st = op.reconcile()
+    assert st["state"] == "ready"
+    assert st["head"]["ready"]
+    assert st["worker_groups"]["cpu"]["ready"] == 2
+    # Idempotent: nothing new appears.
+    assert op.reconcile()["num_pods"] == 3
+
+
+def test_head_crash_replaced():
+    api = MockKubeAPI()
+    op = RayClusterOperator(api, _spec(replicas=1))
+    op.reconcile()
+    head = [p for p in api.list_pods({"ray.io/role": "head"})][0]
+    api.fail_pod(head.name)
+    op.reconcile()  # deletes the failed head
+    st = op.reconcile()  # recreates
+    heads = api.list_pods({"ray.io/role": "head"})
+    assert len(heads) == 1 and heads[0].name != head.name
+    assert st["head"]["ready"]
+
+
+def test_scale_up_down_clamped():
+    api = MockKubeAPI()
+    op = RayClusterOperator(api, _spec(replicas=2))
+    op.reconcile()
+    op.scale_group("cpu", 9)  # clamped to max_replicas=4
+    st = op.reconcile()
+    assert st["worker_groups"]["cpu"]["ready"] + \
+        st["worker_groups"]["cpu"]["pending"] == 4
+    op.scale_group("cpu", 1)
+    op.reconcile()
+    pods = [p for p in api.list_pods({"ray.io/cluster": "demo"})
+            if p.role == "worker"]
+    assert len(pods) == 1
+    with pytest.raises(KeyError):
+        op.scale_group("nope", 1)
+
+
+def test_failed_worker_replaced_preserving_count():
+    api = MockKubeAPI()
+    op = RayClusterOperator(api, _spec(replicas=2))
+    op.reconcile()
+    victim = [p for p in api.list_pods({"ray.io/role": "worker"})][0]
+    api.fail_pod(victim.name)
+    op.reconcile()
+    op.reconcile()
+    workers = api.list_pods({"ray.io/role": "worker"})
+    assert len(workers) == 2
+    assert victim.name not in {p.name for p in workers}
+
+
+def test_autoscaler_drives_replicas_through_operator():
+    """StandardAutoscaler scales a worker group by editing the CRD —
+    the KubeRay arrangement, operator owns the pods."""
+    from ray_tpu.autoscaler.autoscaler import (AutoscalerConfig,
+                                               LoadMetrics,
+                                               StandardAutoscaler)
+    from ray_tpu.autoscaler.autoscaler import NodeType
+
+    api = MockKubeAPI()
+    op = RayClusterOperator(api, _spec(replicas=0))
+    op.reconcile()
+    provider = KubeRayNodeProvider(op)
+    cfg = AutoscalerConfig(node_types={
+        "cpu": NodeType(name="cpu", resources={"CPU": 4.0},
+                        max_workers=4),
+    })
+    autoscaler = StandardAutoscaler(provider, cfg)
+    metrics = LoadMetrics()
+    metrics.set_pending_demands([{"CPU": 4.0}] * 2)
+    autoscaler.update(metrics)
+    op.reconcile()
+    st = op.status()
+    assert st["worker_groups"]["cpu"]["ready"] + \
+        st["worker_groups"]["cpu"]["pending"] == 2
+    # Demand gone + idle: autoscaler terminates through the provider.
+    metrics.set_pending_demands([])
+    for p in api.list_pods({"ray.io/role": "worker"}):
+        metrics.update_node(p.name, {"CPU": 4.0}, {"CPU": 4.0})
+    autoscaler.update(metrics)
+
+
+def test_kubectl_api_gated():
+    import shutil
+
+    if shutil.which("kubectl") is None:
+        with pytest.raises(RuntimeError, match="kubectl"):
+            KubectlAPI()
+
+
+def test_background_loop_converges():
+    import time
+
+    api = MockKubeAPI(ready_after=0)
+    op = RayClusterOperator(api, _spec(replicas=2),
+                            poll_interval_s=0.05).run()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if op.status()["state"] == "ready":
+                break
+            time.sleep(0.05)
+        assert op.status()["state"] == "ready"
+    finally:
+        op.stop()

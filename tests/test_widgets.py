@@ -1,0 +1,24 @@
+"""Notebook HTML repr tests (reference: ray.widgets render tests)."""
+
+
+def test_dataset_repr_html(rt_shared):
+    from ray_tpu.data import from_items
+
+    ds = from_items([{"a": i, "b": f"s{i}"} for i in range(10)],
+                    parallelism=2)
+    html = ds._repr_html_()
+    assert "Dataset" in html and "<table>" in html
+    assert "<b>a</b>" in html and "int" in html
+    assert "s0" in html
+
+
+def test_result_grid_repr_html(rt_shared):
+    from ray_tpu.tune import Tuner, grid_search, report
+
+    def obj(config):
+        report({"score": config["x"] * 2.0})
+
+    results = Tuner(obj, param_space={"x": grid_search([1, 2])}).fit()
+    html = results._repr_html_()
+    assert "<table>" in html and "TERMINATED" in html
+    assert "score=2" in html or "score=4" in html
