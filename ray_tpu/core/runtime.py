@@ -1982,6 +1982,11 @@ def _set_worker_mode(worker_runtime) -> None:
     _worker_runtime = worker_runtime
 
 
+def is_worker_process() -> bool:
+    """True in a spawned task/actor worker, False in a driver."""
+    return _worker_runtime is not None
+
+
 def auto_init() -> None:
     if not is_initialized():
         init()

@@ -51,14 +51,45 @@ def start(http_port: Optional[int] = None, http_host: Optional[str] = None,
                   f"{http_host}:{http_port} ignored — serve.shutdown() "
                   "first to change http_options", file=sys.stderr)
         return
+    # Connect-to-existing first (reference: serve.context connects to a
+    # running instance): inside a REPLICA process a deserialized
+    # DeploymentHandle must reach the cluster's controller, not boot a
+    # second Serve. A DRIVER adopting a detached controller (left by an
+    # exited driver) still starts its own HTTP proxy — the previous
+    # proxy died with its driver; worker processes never own a proxy.
+    # Liveness-checked: right after a shutdown() the name can briefly
+    # resolve to the still-dying controller — adopting a corpse would
+    # hang every later RPC, so an unresponsive hit falls through to a
+    # fresh create.
+    from ..core.runtime import is_worker_process
+
+    try:
+        existing = get_actor(_CONTROLLER_NAME)
+    except Exception:
+        existing = None
+    if existing is not None:
+        try:
+            get(existing.get_deployment_names.remote(), timeout=5)
+            _state["controller"] = existing
+            if not is_worker_process():
+                _start_http_proxy(http_host, http_port)
+            return
+        except Exception:
+            existing = None
     controller_cls = remote(ServeController)
-    controller = controller_cls.options(
-        name=_CONTROLLER_NAME, max_concurrency=64,
-        lifetime="detached" if detached else None,
-    ).remote()
-    get(controller.start_loop.remote(), timeout=30)
+    try:
+        controller = controller_cls.options(
+            name=_CONTROLLER_NAME, max_concurrency=64,
+            lifetime="detached" if detached else None,
+        ).remote()
+        get(controller.start_loop.remote(), timeout=30)
+    except ValueError:
+        # Lost the create race (or the liveness probe under-estimated a
+        # busy-but-healthy controller): adopt whoever owns the name now.
+        controller = get_actor(_CONTROLLER_NAME)
     _state["controller"] = controller
-    _start_http_proxy(http_host, http_port)
+    if not is_worker_process():
+        _start_http_proxy(http_host, http_port)
 
 
 def is_running() -> bool:
@@ -143,12 +174,28 @@ class StreamingResponse:
 
 
 class DeploymentHandle:
-    """Python-side handle (reference: serve/handle.py ServeHandle)."""
+    """Python-side handle (reference: serve/handle.py ServeHandle).
+
+    Pickles as (name, max_concurrent) only — the router (which holds
+    actor handles and a controller reference) rebuilds lazily in the
+    receiving process, so handles can ride deployment-graph init args
+    into replicas."""
 
     def __init__(self, name: str, max_concurrent_queries: int = 100):
         self._name = name
-        self._router = Router(_controller(), name, max_concurrent_queries)
-        _state.setdefault("routers", []).append(self._router)
+        self._mcq = max_concurrent_queries
+        self._router_obj = None
+
+    @property
+    def _router(self):
+        if self._router_obj is None:
+            self._router_obj = Router(_controller(), self._name,
+                                      self._mcq)
+            _state.setdefault("routers", []).append(self._router_obj)
+        return self._router_obj
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._name, self._mcq))
 
     def remote(self, *args, **kwargs):
         return self._router.assign(None, args, kwargs)
@@ -264,14 +311,48 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
     return wrap
 
 
+def _resolve_graph(value, deployed: Dict[int, DeploymentHandle]):
+    """Deployment-graph composition (reference: serve's DeploymentNode
+    graphs — ``Ensemble.bind(ModelA.bind(), ModelB.bind())``): nested
+    Applications inside bind args deploy first (DFS, deduped per bound
+    node) and are replaced by their DeploymentHandles, so a parent
+    deployment receives live handles to its children in __init__."""
+    if isinstance(value, Application):
+        key = id(value)
+        if key not in deployed:
+            deployed[key] = _deploy_app(value, deployed)
+        return deployed[key]
+    if isinstance(value, (list, tuple)):
+        resolved = [_resolve_graph(v, deployed) for v in value]
+        if all(a is b for a, b in zip(resolved, value)):
+            return value  # untouched (incl. namedtuples/subclasses)
+        if isinstance(value, tuple) and hasattr(value, "_fields"):
+            return type(value)(*resolved)  # namedtuple: positional ctor
+        return type(value)(resolved)
+    if isinstance(value, dict):
+        return {k: _resolve_graph(v, deployed)
+                for k, v in value.items()}
+    return value
+
+
+def _deploy_app(app: Application,
+                deployed: Dict[int, DeploymentHandle]) -> DeploymentHandle:
+    args = tuple(_resolve_graph(a, deployed) for a in app.args)
+    kwargs = {k: _resolve_graph(v, deployed)
+              for k, v in app.kwargs.items()}
+    return app.deployment.deploy(*args, **kwargs)
+
+
 def run(app: Application, *, name: str = "default",
         route_prefix: Optional[str] = None) -> DeploymentHandle:
-    """Deploy a bound application (reference: serve.run)."""
+    """Deploy a bound application — including any deployment graph
+    nested in its bind args (reference: serve.run + deployment graphs).
+    Returns the handle of the ROOT (ingress) deployment."""
     start()
     dep = app.deployment
     if route_prefix is not None:
         dep = dep.options(route_prefix=route_prefix)
-    return dep.deploy(*app.args, **app.kwargs)
+    return _deploy_app(Application(dep, app.args, app.kwargs), {})
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:
@@ -301,6 +382,10 @@ class _AsyncHTTPProxy:
         self._host = host
         self._port = port
         self._handles: Dict[str, DeploymentHandle] = {}
+        # route_prefix -> deployment name (refreshed from the
+        # controller on miss; reference: the proxy's route table pushed
+        # by the controller's LongestPrefixRouter).
+        self._routes: Dict[str, str] = {}
         # Per-deployment request coalescers (Nagle-style): concurrent
         # requests that arrive while a replica RPC is in flight ride the
         # NEXT batch — one actor hop serves many requests, with zero
@@ -477,40 +562,69 @@ class _AsyncHTTPProxy:
             % (status, b"OK" if status == 200 else b"ERR",
                len(payload), conn, payload))
 
+    def _resolve_route(self, path: str) -> Optional[str]:
+        """Longest-prefix match of the request path against registered
+        route prefixes (reference: LongestPrefixRouter.match_route)."""
+        best = None
+        for prefix, name in self._routes.items():
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, name)
+        return best[1] if best else None
+
     async def _route(self, writer, target: str, body: bytes,
                      keep: bool) -> bool:
         """Handle one request. Returns False when the connection must be
         closed (e.g. a failure after a chunked response started — a 500
         cannot be written into the middle of a chunked body)."""
-        name = target.split("?")[0].strip("/").split("/")[0]
-        if not name:
-            self._write_simple(
-                writer, 404, b'{"error": "no deployment in path"}', keep)
-            return True
+        # Normalized to no trailing slash; "/" itself stays routable
+        # (a deployment may mount at route_prefix="/").
+        path = "/" + target.split("?")[0].strip("/")
         payload = None
         if body:
             try:
                 payload = json.loads(body)
             except json.JSONDecodeError:
                 payload = body.decode("utf-8", "replace")
+        name = None
         try:
+            name = self._resolve_route(path)
+            if name is None:
+                # Cache miss: refresh the route table from the
+                # controller (covers both custom route_prefix values
+                # and the default /<name> routes).
+                table = await self._aget(
+                    _controller().list_deployments.remote(), 10)
+                self._routes = {}
+                for n, info in table.items():
+                    prefix = info.get("route_prefix") or f"/{n}"
+                    # Same normalization as request paths, so
+                    # "/api/" matches GET /api.
+                    prefix = "/" + prefix.strip("/")
+                    self._routes[prefix] = n
+                name = self._resolve_route(path)
+            if name is None:
+                self._write_simple(
+                    writer, 404,
+                    json.dumps(
+                        {"error": f"no route matches {path}"}
+                    ).encode(), keep)
+                return True
             handle = self._handles.get(name)
             if handle is None:
-                names = await self._aget(
-                    _controller().get_deployment_names.remote(), 10)
-                if name not in names:
-                    self._write_simple(
-                        writer, 404,
-                        json.dumps(
-                            {"error": f"unknown deployment {name}"}
-                        ).encode(), keep)
-                    return True
                 handle = DeploymentHandle(name)
                 self._handles[name] = handle
             args = () if payload is None else (payload,)
             result, replica = await self._submit_coalesced(
                 name, handle, args)
         except Exception as e:  # noqa: BLE001
+            # The deployment may have been deleted/replaced since the
+            # route cached: drop the ROUTE cache so the next request
+            # re-resolves. The handle stays — its Router owns a live
+            # long-poll listener thread that tracks replica-set changes
+            # itself; popping it here would leak one such thread per
+            # failing request.
+            self._routes = {}
             try:
                 self._write_simple(
                     writer, 500, json.dumps({"error": str(e)}).encode(),

@@ -1,0 +1,148 @@
+"""Deployment-graph composition tests (reference: serve deployment
+graphs — Ensemble.bind(ModelA.bind(), ModelB.bind()))."""
+
+import ray_tpu as rt
+from ray_tpu import serve
+
+
+def test_nested_bind_composes_deployments(rt_shared):
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return 2 * x
+
+    @serve.deployment
+    class Adder:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def __call__(self, x):
+            return x + self.offset
+
+    @serve.deployment
+    class Ensemble:
+        def __init__(self, doubler, adder):
+            # Children arrive as live DeploymentHandles.
+            self.doubler = doubler
+            self.adder = adder
+
+        def __call__(self, x):
+            a = rt.get(self.doubler.remote(x))
+            b = rt.get(self.adder.remote(x))
+            return a + b
+
+    app = Ensemble.bind(Doubler.bind(), Adder.bind(10))
+    handle = serve.run(app)
+    try:
+        assert rt.get(handle.remote(5)) == 10 + 15  # 2*5 + (5+10)
+        deployments = serve.list_deployments()
+        assert {"Ensemble", "Doubler", "Adder"} <= set(deployments)
+    finally:
+        serve.shutdown()
+
+
+def test_shared_child_deployed_once(rt_shared):
+    # 4 replicas + the controller exceed the 4-CPU fixture at 1 CPU
+    # each; fractional CPUs keep the whole graph schedulable.
+    @serve.deployment(ray_actor_options={"num_cpus": 0.25})
+    class Leaf:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment(ray_actor_options={"num_cpus": 0.25})
+    class Mid:
+        def __init__(self, leaf, tag):
+            self.leaf = leaf
+            self.tag = tag
+
+        def __call__(self, x):
+            return (self.tag, rt.get(self.leaf.remote(x)))
+
+    @serve.deployment(ray_actor_options={"num_cpus": 0.25})
+    class Root:
+        def __init__(self, children):
+            self.children = children
+
+        def __call__(self, x):
+            return [rt.get(c.remote(x)) for c in self.children]
+
+    leaf = Leaf.bind()
+    app = Root.bind([Mid.options(name="MidA").bind(leaf, "a"),
+                     Mid.options(name="MidB").bind(leaf, "b")])
+    handle = serve.run(app)
+    try:
+        assert rt.get(handle.remote(1)) == [("a", 2), ("b", 2)]
+        # The SAME bound child deploys once, not once per parent.
+        assert list(serve.list_deployments()).count("Leaf") == 1
+    finally:
+        serve.shutdown()
+
+
+def test_namedtuple_bind_args_pass_through(rt_shared):
+    from collections import namedtuple
+
+    Config = namedtuple("Config", "a b")
+
+    @serve.deployment
+    class Model:
+        def __init__(self, cfg):
+            self.cfg = cfg
+
+        def __call__(self, _):
+            return self.cfg.a + self.cfg.b
+
+    handle = serve.run(Model.bind(Config(3, 4)))
+    try:
+        assert rt.get(handle.remote(None)) == 7
+    finally:
+        serve.shutdown()
+
+
+def test_route_prefix_routing(rt_shared):
+    import json
+    import urllib.request
+
+    serve.start(http_port=18627)
+
+    @serve.deployment(route_prefix="/api/v1")
+    class Api:
+        def __call__(self, payload):
+            return {"got": payload}
+
+    serve.run(Api.bind())
+    try:
+        req = urllib.request.Request(
+            "http://127.0.0.1:18627/api/v1", method="POST",
+            data=json.dumps({"k": 1}).encode())
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read()) == {"got": {"k": 1}}
+        # Subpaths route to the same deployment; unknown paths 404.
+        req = urllib.request.Request(
+            "http://127.0.0.1:18627/api/v1/sub", method="POST",
+            data=b"\"x\"")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read()) == {"got": "x"}
+        try:
+            urllib.request.urlopen(
+                "http://127.0.0.1:18627/nope", timeout=30)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        serve.shutdown()
+
+
+def test_handle_pickles_by_name(rt_shared):
+    import pickle
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Echo.bind())
+    try:
+        clone = pickle.loads(pickle.dumps(handle))
+        assert rt.get(clone.remote("hi")) == "hi"
+    finally:
+        serve.shutdown()
