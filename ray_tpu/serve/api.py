@@ -77,16 +77,32 @@ def start(http_port: Optional[int] = None, http_host: Optional[str] = None,
         except Exception:
             existing = None
     controller_cls = remote(ServeController)
-    try:
-        controller = controller_cls.options(
+
+    def _create():
+        c = controller_cls.options(
             name=_CONTROLLER_NAME, max_concurrency=64,
             lifetime="detached" if detached else None,
         ).remote()
-        get(controller.start_loop.remote(), timeout=30)
+        get(c.start_loop.remote(), timeout=30)
+        return c
+
+    try:
+        controller = _create()
     except ValueError:
-        # Lost the create race (or the liveness probe under-estimated a
-        # busy-but-healthy controller): adopt whoever owns the name now.
-        controller = get_actor(_CONTROLLER_NAME)
+        # Name taken: either we lost a create race to a HEALTHY
+        # controller (adopt it), or the name still points at the corpse
+        # the liveness probe rejected (kill it to free the name, then
+        # create fresh — adopting the corpse would hang every RPC).
+        owner = get_actor(_CONTROLLER_NAME)
+        try:
+            get(owner.get_deployment_names.remote(), timeout=5)
+            controller = owner
+        except Exception:
+            try:
+                kill(owner)
+            except Exception:
+                pass
+            controller = _create()
     _state["controller"] = controller
     if not is_worker_process():
         _start_http_proxy(http_host, http_port)
@@ -185,13 +201,19 @@ class DeploymentHandle:
         self._name = name
         self._mcq = max_concurrent_queries
         self._router_obj = None
+        self._router_lock = threading.Lock()
 
     @property
     def _router(self):
+        # Locked: a handle shared across threads must build exactly ONE
+        # router — each Router starts a long-poll listener thread, and a
+        # first-use race would leak the loser's thread until shutdown.
         if self._router_obj is None:
-            self._router_obj = Router(_controller(), self._name,
-                                      self._mcq)
-            _state.setdefault("routers", []).append(self._router_obj)
+            with self._router_lock:
+                if self._router_obj is None:
+                    router = Router(_controller(), self._name, self._mcq)
+                    _state.setdefault("routers", []).append(router)
+                    self._router_obj = router
         return self._router_obj
 
     def __reduce__(self):
@@ -330,8 +352,13 @@ def _resolve_graph(value, deployed: Dict[int, DeploymentHandle]):
             return type(value)(*resolved)  # namedtuple: positional ctor
         return type(value)(resolved)
     if isinstance(value, dict):
-        return {k: _resolve_graph(v, deployed)
-                for k, v in value.items()}
+        resolved = {k: _resolve_graph(v, deployed)
+                    for k, v in value.items()}
+        if all(resolved[k] is value[k] for k in resolved):
+            return value  # untouched (incl. dict subclasses)
+        out = value.copy()  # preserve subclass type + extra state
+        out.update(resolved)
+        return out
     return value
 
 
@@ -383,9 +410,13 @@ class _AsyncHTTPProxy:
         self._port = port
         self._handles: Dict[str, DeploymentHandle] = {}
         # route_prefix -> deployment name (refreshed from the
-        # controller on miss; reference: the proxy's route table pushed
-        # by the controller's LongestPrefixRouter).
+        # controller on miss OR when stale; reference: the proxy's
+        # route table pushed by the controller's LongestPrefixRouter —
+        # pull-based here, so a TTL bounds how long a newly-deployed
+        # longer prefix can be shadowed by a cached shorter one).
         self._routes: Dict[str, str] = {}
+        self._routes_ts: float = 0.0
+        self._routes_ttl_s: float = 5.0
         # Per-deployment request coalescers (Nagle-style): concurrent
         # requests that arrive while a replica RPC is in flight ride the
         # NEXT batch — one actor hop serves many requests, with zero
@@ -588,11 +619,17 @@ class _AsyncHTTPProxy:
                 payload = body.decode("utf-8", "replace")
         name = None
         try:
-            name = self._resolve_route(path)
+            import time as _time
+
+            stale = (_time.monotonic() - self._routes_ts
+                     > self._routes_ttl_s)
+            name = None if stale else self._resolve_route(path)
             if name is None:
-                # Cache miss: refresh the route table from the
-                # controller (covers both custom route_prefix values
-                # and the default /<name> routes).
+                # Miss or stale: refresh the route table from the
+                # controller (covers custom route_prefix values, the
+                # default /<name> routes, and newly-added longer
+                # prefixes that would otherwise stay shadowed by a
+                # cached shorter match).
                 table = await self._aget(
                     _controller().list_deployments.remote(), 10)
                 self._routes = {}
@@ -602,6 +639,7 @@ class _AsyncHTTPProxy:
                     # "/api/" matches GET /api.
                     prefix = "/" + prefix.strip("/")
                     self._routes[prefix] = n
+                self._routes_ts = _time.monotonic()
                 name = self._resolve_route(path)
             if name is None:
                 self._write_simple(
@@ -618,13 +656,12 @@ class _AsyncHTTPProxy:
             result, replica = await self._submit_coalesced(
                 name, handle, args)
         except Exception as e:  # noqa: BLE001
-            # The deployment may have been deleted/replaced since the
-            # route cached: drop the ROUTE cache so the next request
-            # re-resolves. The handle stays — its Router owns a live
-            # long-poll listener thread that tracks replica-set changes
-            # itself; popping it here would leak one such thread per
-            # failing request.
-            self._routes = {}
+            # No cache surgery here: an application-level 500 says
+            # nothing about routes, and the TTL already bounds how long
+            # a deleted deployment's route can linger. The handle stays
+            # — its Router owns a live long-poll listener thread that
+            # tracks replica-set changes itself; popping it per failing
+            # request would leak one such thread each time.
             try:
                 self._write_simple(
                     writer, 500, json.dumps({"error": str(e)}).encode(),

@@ -69,12 +69,25 @@ def test_shared_child_deployed_once(rt_shared):
     leaf = Leaf.bind()
     app = Root.bind([Mid.options(name="MidA").bind(leaf, "a"),
                      Mid.options(name="MidB").bind(leaf, "b")])
-    handle = serve.run(app)
+    # Spy on deploy calls: the SAME bound child must deploy once, not
+    # once per parent (name-keyed redeploys would hide the duplicate).
+    from ray_tpu.serve.api import Deployment
+
+    deploys = []
+    orig_deploy = Deployment.deploy
+
+    def spying_deploy(self, *a, **k):
+        deploys.append(self.name)
+        return orig_deploy(self, *a, **k)
+
+    Deployment.deploy = spying_deploy
     try:
+        handle = serve.run(app)
         assert rt.get(handle.remote(1)) == [("a", 2), ("b", 2)]
-        # The SAME bound child deploys once, not once per parent.
-        assert list(serve.list_deployments()).count("Leaf") == 1
+        assert deploys.count("Leaf") == 1, deploys
+        assert sorted(deploys) == ["Leaf", "MidA", "MidB", "Root"]
     finally:
+        Deployment.deploy = orig_deploy
         serve.shutdown()
 
 
