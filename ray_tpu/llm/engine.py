@@ -118,6 +118,10 @@ class _Slot:
     # previous decode block's `last` output) — its next block input
     # chains device-side with no host round trip.
     on_device_chain: bool = False
+    # True between dispatching the FINAL prefill chunk and fetching its
+    # sampled first token (lag-1 pipeline): the slot must not join the
+    # decode batch until that token is known host-side.
+    first_tok_pending: bool = False
 
     @property
     def prefill_done(self) -> bool:
@@ -129,6 +133,10 @@ class SlotEngine:
 
     def __init__(self, params, cfg: llama.LlamaConfig, num_slots: int = 8,
                  chunk: int = 64, seed: int = 0, decode_block: int = 1):
+        if cfg.max_seq % chunk != 0:
+            raise ValueError(
+                f"chunk ({chunk}) must divide max_seq ({cfg.max_seq}): "
+                "a padded tail chunk would clamp past the cache end")
         self.cfg = cfg
         self.num_slots = num_slots
         self.chunk = chunk
@@ -143,11 +151,51 @@ class SlotEngine:
         # discarded; garbage K/V is overwritten before ever attended).
         self.decode_block = decode_block
         self._params = jax.device_put(params)
-        self._cache = llama.init_kv_cache(cfg, num_slots)
+        # One extra SCRATCH slot: idle steps point the fused program's
+        # prefill lane at it, so inactive-prefill writes never touch a
+        # real request's pages. Requests only ever occupy slots
+        # [0, num_slots).
+        self._nrows = num_slots + 1
+        self._scratch = num_slots
+        self._cache = llama.init_kv_cache(cfg, self._nrows)
         self._key = jax.random.PRNGKey(seed)
 
-        def decode_block_fn(params, cache, override_vals, override_mask,
-                            prev_last, pos, temps, key):
+        def block_fn(params, cache, override_vals, override_mask,
+                     prev_last, pos, temps, key,
+                     pre_tokens, pre_slot, pre_p0, pre_last_idx,
+                     pre_temp):
+            """K-token decode block with the prefill lane fused into the
+            FIRST step (decode_slots_with_prefill): a prompt chunk rides
+            the same params read as the decode batch, so prefill no
+            longer costs a separate full-model pass."""
+            tokens0 = jnp.where(override_mask, override_vals, prev_last)
+            key, k0, kp = jax.random.split(key, 3)
+            dec_logits, pre_logits, cache = \
+                llama.decode_slots_with_prefill(
+                    params, cache, tokens0, pos, pre_tokens, pre_slot,
+                    pre_p0, pre_last_idx, cfg)
+            tok1 = _sample(dec_logits, temps, k0)
+            pre_tok = _sample(pre_logits[None], pre_temp[None], kp)[0]
+
+            def body(carry, _):
+                toks, cache, p, key = carry
+                key, sub = jax.random.split(key)
+                logits, cache = llama.decode_slots(params, cache, toks, p,
+                                                   cfg)
+                nxt = _sample(logits, temps, sub)
+                return (nxt, cache, p + 1, key), nxt
+
+            (last, cache, _, _), toks_rest = jax.lax.scan(
+                body, (tok1, cache, pos + 1, key), None,
+                length=decode_block - 1)
+            toks_k = jnp.concatenate([tok1[None], toks_rest], axis=0)
+            return toks_k, last, pre_tok, cache
+
+        def decode_only_fn(params, cache, override_vals, override_mask,
+                           prev_last, pos, temps, key):
+            """Pure K-step decode block — dispatched whenever no prompt
+            chunk is pending, so idle steps never pay the fused
+            program's C-token prefill lane."""
             tokens0 = jnp.where(override_mask, override_vals, prev_last)
 
             def body(carry, _):
@@ -163,21 +211,13 @@ class SlotEngine:
                 length=decode_block)
             return toks_k, last, cache
 
-        def prefill_step(params, cache, tokens, slot, p0, last_idx, temp,
-                         key):
-            logits, cache = llama.prefill_chunk(params, cache, tokens,
-                                                slot, p0, cfg,
-                                                last_idx=last_idx)
-            tok = _sample(logits[None], temp[None], key)[0]
-            return tok, cache
-
         # The cache is donated: XLA updates it in place, so a decode
         # step never copies the (potentially multi-GB) KV pages.
-        self._decode = jax.jit(decode_block_fn, donate_argnums=(1,))
-        self._prefill = jax.jit(prefill_step, donate_argnums=(1,))
+        self._block = jax.jit(block_fn, donate_argnums=(1,))
+        self._decode_only = jax.jit(decode_only_fn, donate_argnums=(1,))
         # lag-1 decode pipeline state
-        self._inflight = None  # (snapshot, toks_k_dev)
-        self._last_dev = jnp.zeros((num_slots,), jnp.int32)
+        self._inflight = None  # (snapshot, pre_info, toks_k, pre_tok)
+        self._last_dev = jnp.zeros((self._nrows,), jnp.int32)
 
         self._slots: List[Optional[_Slot]] = [None] * num_slots
         self._pending: deque = deque()
@@ -274,9 +314,10 @@ class SlotEngine:
                 s.on_token(None)
 
     def step(self) -> bool:
-        """One scheduler iteration: admit, one prefill chunk, dispatch a
-        decode block, then fetch the PREVIOUS block's tokens (which are
-        ready by now — lag-1 pipelining). Returns True if any work ran."""
+        """One scheduler iteration: admit, dispatch a fused
+        decode+prefill block, then fetch the PREVIOUS block's tokens
+        (ready by now — lag-1 pipelining). Returns True if any work
+        ran."""
         with self._lock:
             for i in range(self.num_slots):
                 if self._slots[i] is None and self._pending:
@@ -285,12 +326,11 @@ class SlotEngine:
                 (i for i, s in enumerate(self._slots)
                  if s is not None and not s.prefill_done), None)
             active = [(i, s) for i, s in enumerate(self._slots)
-                      if s is not None and s.prefill_done]
+                      if s is not None and s.prefill_done
+                      and not s.first_tok_pending]
         ran = False
-        if prefill_idx is not None:
-            self._prefill_one_chunk(prefill_idx)
-            ran = True
-        new_block = self._decode_dispatch(active) if active else None
+        new_block = (self._dispatch_block(active, prefill_idx)
+                     if (active or prefill_idx is not None) else None)
         if self._inflight is not None:
             self._process_fetch()
             ran = True
@@ -303,35 +343,18 @@ class SlotEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def _prefill_one_chunk(self, idx: int) -> None:
-        s = self._slots[idx]
-        c = self.chunk
-        p0 = s.prefill_offset
-        piece = s.prompt[p0:p0 + c]
-        n_valid = len(piece)
-        buf = np.zeros((c,), dtype=np.int32)
-        buf[:n_valid] = piece
-        tok, self._cache = self._prefill(
-            self._params, self._cache, jnp.asarray(buf),
-            jnp.asarray(idx, jnp.int32), jnp.asarray(p0, jnp.int32),
-            jnp.asarray(n_valid - 1, jnp.int32),
-            jnp.asarray(s.temperature, jnp.float32), self._next_key())
-        s.prefill_offset = p0 + n_valid
-        if s.prefill_done:
-            first = int(tok)  # device sync: one int
-            s.pos = len(s.prompt)
-            self._deliver(idx, s, first)
-
-    def _decode_dispatch(self, active):
-        """Dispatch one K-step decode block; returns the pipeline entry.
-        Continuing slots chain their input token device-side (no host
-        round trip); freshly prefilled slots inject theirs via the
-        override vector."""
+    def _dispatch_block(self, active, prefill_idx):
+        """Dispatch one K-step block: every active slot decodes K
+        tokens and (when a slot is mid-prompt) ONE prefill chunk rides
+        the first step's fused program. Continuing slots chain their
+        input token device-side; freshly prefilled slots inject theirs
+        via the override vector."""
         cfg = self.cfg
-        override_vals = np.zeros((self.num_slots,), dtype=np.int32)
-        override_mask = np.ones((self.num_slots,), dtype=bool)
-        pos = np.full((self.num_slots,), cfg.max_seq - 1, dtype=np.int32)
-        temps = np.zeros((self.num_slots,), dtype=np.float32)
+        rows = self._nrows
+        override_vals = np.zeros((rows,), dtype=np.int32)
+        override_mask = np.ones((rows,), dtype=bool)
+        pos = np.full((rows,), cfg.max_seq - 1, dtype=np.int32)
+        temps = np.zeros((rows,), dtype=np.float32)
         for i, s in active:
             pos[i] = s.pos
             temps[i] = s.temperature
@@ -339,19 +362,46 @@ class SlotEngine:
                 override_mask[i] = False
             else:
                 override_vals[i] = s.last_token
-        toks_k, self._last_dev, self._cache = self._decode(
+        if prefill_idx is None:
+            # No prompt chunk pending: the cheap pure-decode program.
+            toks_k, self._last_dev, self._cache = self._decode_only(
+                self._params, self._cache, jnp.asarray(override_vals),
+                jnp.asarray(override_mask), self._last_dev,
+                jnp.asarray(pos), jnp.asarray(temps), self._next_key())
+            for i, s in active:
+                s.pos += self.decode_block
+                s.on_device_chain = True
+            return (list(active), None, toks_k, None)
+        # Prefill lane: one chunk of one slot's prompt rides the fused
+        # program's first step.
+        pre_buf = np.zeros((self.chunk,), dtype=np.int32)
+        s = self._slots[prefill_idx]
+        p0 = s.prefill_offset
+        piece = s.prompt[p0:p0 + self.chunk]
+        n_valid = len(piece)
+        pre_buf[:n_valid] = piece
+        s.prefill_offset = p0 + n_valid
+        final = s.prefill_done
+        if final:
+            s.first_tok_pending = True
+        pre_info = (prefill_idx, s, final)
+        toks_k, self._last_dev, pre_tok, self._cache = self._block(
             self._params, self._cache, jnp.asarray(override_vals),
             jnp.asarray(override_mask), self._last_dev, jnp.asarray(pos),
-            jnp.asarray(temps), self._next_key())
+            jnp.asarray(temps), self._next_key(),
+            jnp.asarray(pre_buf), jnp.asarray(prefill_idx, jnp.int32),
+            jnp.asarray(p0, jnp.int32),
+            jnp.asarray(n_valid - 1, jnp.int32),
+            jnp.asarray(s.temperature, jnp.float32))
         for i, s in active:
             s.pos += self.decode_block
             s.on_device_chain = True
-        return (list(active), toks_k)
+        return (list(active), pre_info, toks_k, pre_tok)
 
     def _process_fetch(self) -> None:
-        snapshot, toks_k = self._inflight
+        snapshot, pre_info, toks_k, pre_tok = self._inflight
         self._inflight = None
-        arr = np.asarray(toks_k)  # [K, num_slots]; ready -> fast fetch
+        arr = np.asarray(toks_k)  # [K, rows]; ready -> fast fetch
         for idx, s in snapshot:
             if self._slots[idx] is not s:
                 continue  # finished in an earlier block; rows are garbage
@@ -359,6 +409,16 @@ class SlotEngine:
                 self._deliver(idx, s, int(arr[k, idx]))
                 if self._slots[idx] is not s:
                     break  # eos / length hit mid-block; drop overshoot
+        if pre_info is not None:
+            idx, s, final = pre_info
+            if final and self._slots[idx] is s:
+                # The prompt's sampled first token arrives with the
+                # block fetch; the slot joins the decode batch next
+                # dispatch (override lane — the token is host-side).
+                s.first_tok_pending = False
+                s.pos = len(s.prompt)
+                s.on_device_chain = False
+                self._deliver(idx, s, int(pre_tok))
 
     def _deliver(self, idx: int, s: _Slot, tok: int) -> None:
         s.last_token = tok
