@@ -12,7 +12,10 @@ import ctypes
 import os
 import subprocess
 import threading
+import weakref
 from typing import Optional
+
+import numpy as _np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_HERE, "build", "libshmstore.so")
@@ -61,6 +64,13 @@ def _load_lib():
         ]
         lib.rt_store_seal.restype = ctypes.c_int
         lib.rt_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_put_frame.restype = ctypes.c_int
+        lib.rt_store_put_frame.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint32,
+        ]
         lib.rt_store_abort.restype = ctypes.c_int
         lib.rt_store_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.rt_store_get.restype = ctypes.POINTER(ctypes.c_ubyte)
@@ -115,37 +125,34 @@ class NativeStoreUnsealed(NativeStoreError):
     it may abort() the wedged reservation and retry."""
 
 
-class _PinnedExtent:
-    """Owns one pin (refcount) on a sealed arena extent.
+def _pinned_view(store: "NativeStore", key: bytes, ptr: int,
+                 size: int) -> memoryview:
+    """Read-only view over a pinned arena extent whose pin is released
+    when the LAST derived view is garbage-collected.
 
-    ``memoryview(pinned)`` exports a read-only buffer via ``__buffer__``
-    (PEP 688); every derived slice — including numpy arrays rebuilt from
-    out-of-band pickle buffers — keeps this object alive, and the pin is
-    released when the last one is collected. Deferred-free in the store
-    (``SLOT_PENDING_DELETE``) guarantees the extent is not reused while
-    pinned, so zero-copy values can safely outlive the object's deletion.
-    """
+    The ctypes array is the buffer exporter: every derived slice —
+    including numpy arrays rebuilt from out-of-band pickle buffers —
+    keeps it alive through the buffer protocol, and ``weakref.finalize``
+    fires the release exactly once when the exporter is collected.
+    (A ``__buffer__``-based exporter class would need PEP 688, py3.12+;
+    the finalize pin works on every supported interpreter.) Deferred-free
+    in the store (``SLOT_PENDING_DELETE``) guarantees the extent is not
+    reused while pinned, so zero-copy values safely outlive deletion."""
+    arr = (ctypes.c_ubyte * max(size, 1)).from_address(ptr)
+    key = bytes(key)
+    lib, handle = store._lib, store._handle
 
-    __slots__ = ("_store", "_key", "_arr", "_size")
-
-    def __init__(self, store: "NativeStore", key: bytes, ptr: int, size: int):
-        self._store = store
-        self._key = bytes(key)
-        self._size = size
-        self._arr = (ctypes.c_ubyte * max(size, 1)).from_address(ptr)
-
-    def __buffer__(self, flags):
-        # ctypes exports format "<B"; cast to "B" so consumers (pickle
-        # buffer loads, numpy frombuffer) accept it.
-        return memoryview(self._arr).cast("B").toreadonly()[: self._size]
-
-    def __del__(self):
-        store = getattr(self, "_store", None)
-        if store is not None and not store._closed:
+    def _release():
+        if not store._closed:
             try:
-                store._lib.rt_store_release(store._handle, self._key)
+                lib.rt_store_release(handle, key)
             except Exception:
                 pass
+
+    weakref.finalize(arr, _release)
+    # ctypes exports format "<B"; cast to "B" so consumers (pickle
+    # buffer loads, numpy frombuffer) accept it.
+    return memoryview(arr).cast("B").toreadonly()[:size]
 
 
 class NativeStore:
@@ -209,7 +216,7 @@ class NativeStore:
         if not ptr:
             return None
         addr = ctypes.cast(ptr, ctypes.c_void_p).value
-        return memoryview(_PinnedExtent(self, key, addr, size.value))
+        return _pinned_view(self, key, addr, size.value)
 
     def create_object(self, key: bytes, size: int) -> memoryview:
         """Reserve an extent and return a WRITABLE view into the arena;
@@ -237,6 +244,42 @@ class NativeStore:
         rc = self._lib.rt_store_seal(self._handle, key)
         if rc != 0:
             raise NativeStoreError(f"seal failed rc={rc}")
+
+    def put_frame(self, key: bytes, inband: bytes, buffers) -> None:
+        """One-call owner put of a serialized frame (reserve → C-side
+        copy with the lock released → seal); layout identical to
+        ``serialization.SerializedObject.write_into`` (the C side owns
+        the only other copy of the offset math — callers wanting the
+        frame size use ``SerializedObject.frame_bytes()``). ``buffers``
+        is a sequence of PickleBuffers. Raises the same exceptions as
+        create_object."""
+        n = len(buffers)
+        ptrs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_uint64 * n)()
+        raws = []  # keep buffer views alive across the C call
+        for i, b in enumerate(buffers):
+            raw = b.raw()
+            # np.frombuffer yields a pointer for read-only exporters
+            # too (ctypes.from_buffer insists on writable).
+            arr = _np.frombuffer(raw, dtype=_np.uint8)
+            raws.append((raw, arr))
+            ptrs[i] = arr.ctypes.data
+            lens[i] = raw.nbytes
+        rc = self._lib.rt_store_put_frame(
+            self._handle, key, inband, len(inband), ptrs, lens, n)
+        if rc == 0:
+            return
+        if rc == -1:
+            raise NativeStoreExists(key.hex())
+        if rc == -2:
+            raise NativeStoreFull("arena full")
+        if rc == -3:
+            raise NativeStoreError("object table full")
+        if rc == -5:
+            raise NativeStorePendingDelete(key.hex())
+        if rc == -6:
+            raise NativeStoreUnsealed(key.hex())
+        raise NativeStoreError(f"put_frame failed rc={rc}")
 
     def abort(self, key: bytes) -> None:
         self._lib.rt_store_abort(self._handle, key)

@@ -275,6 +275,13 @@ void* rt_store_create(const char* name, uint64_t capacity) {
     shm_unlink(name);
     return nullptr;
   }
+#ifdef MADV_HUGEPAGE
+  // Best-effort: where shmem THP is enabled, 2MB mappings cut the TLB
+  // cost of bulk copies into the arena (a 10MB put touches 2560 4K
+  // pages; heap destinations already get THP, so without this the put
+  // medium starts ~15-20% behind a heap memcpy). Ignored elsewhere.
+  madvise(base, total, MADV_HUGEPAGE);
+#endif
   memset(base, 0, data_start);
   StoreHeader* h = reinterpret_cast<StoreHeader*>(base);
   h->capacity = capacity;
@@ -313,6 +320,9 @@ void* rt_store_attach(const char* name) {
     close(fd);
     return nullptr;
   }
+#ifdef MADV_HUGEPAGE
+  madvise(base, st.st_size, MADV_HUGEPAGE);  // see rt_store_create
+#endif
   StoreHeader* h = reinterpret_cast<StoreHeader*>(base);
   if (h->magic != kMagic) {
     munmap(base, st.st_size);
@@ -583,6 +593,21 @@ uint8_t* rt_store_create_object(void* handle, const uint8_t* key,
   return reinterpret_cast<uint8_t*>(arena(s) + off);
 }
 
+// Owner put of a serialized frame in ONE call: reserve the extent
+// (create_object semantics), copy header + inband + 64B-aligned
+// out-of-band buffers with the lock RELEASED (plasma semantics — a
+// slow copy must not serialize other clients' store ops), then seal.
+// The frame layout mirrors serialization.py write_into/_split_frames
+// exactly. Versus driving create/write/seal from Python this saves one
+// mutex round plus per-op ctypes dispatch — measurable on the 10MB put
+// hot path where every post-copy header access runs on cold caches.
+// Returns 0 ok, else create_object's codes (-1 exists, -2 full, -3
+// table full, -4 lock error, -5 pending-delete, -6 unsealed).
+int rt_store_put_frame(void* handle, const uint8_t* key,
+                       const uint8_t* inband, uint64_t inband_len,
+                       const uint8_t* const* bufs,
+                       const uint64_t* buf_lens, uint32_t nbufs);
+
 // Free an unsealed reservation (failed write between create and seal).
 int rt_store_abort(void* handle, const uint8_t* key) {
   Store* s = static_cast<Store*>(handle);
@@ -611,6 +636,41 @@ int rt_store_seal(void* handle, const uint8_t* key) {
   slot->state = SLOT_SEALED;
   h->num_objects++;
   pthread_mutex_unlock(&h->mutex);
+  return 0;
+}
+
+int rt_store_put_frame(void* handle, const uint8_t* key,
+                       const uint8_t* inband, uint64_t inband_len,
+                       const uint8_t* const* bufs,
+                       const uint64_t* buf_lens, uint32_t nbufs) {
+  uint64_t n = 1 + (uint64_t)nbufs;
+  uint64_t off = 4 + 8 * n + inband_len;
+  for (uint32_t i = 0; i < nbufs; i++) {
+    off = ((off + 63) & ~63ull) + buf_lens[i];
+  }
+  int32_t err = 0;
+  uint8_t* dst = rt_store_create_object(handle, key, off, &err);
+  if (!dst) return err;
+  uint32_t n32 = (uint32_t)n;
+  memcpy(dst, &n32, 4);  // all supported targets are little-endian
+  memcpy(dst + 4, &inband_len, 8);
+  for (uint32_t i = 0; i < nbufs; i++) {
+    memcpy(dst + 4 + 8 * (1 + i), &buf_lens[i], 8);
+  }
+  uint64_t w = 4 + 8 * n;
+  if (inband_len) memcpy(dst + w, inband, inband_len);
+  w += inband_len;
+  for (uint32_t i = 0; i < nbufs; i++) {
+    uint64_t aligned = (w + 63) & ~63ull;
+    if (aligned != w) memset(dst + w, 0, aligned - w);
+    if (buf_lens[i]) memcpy(dst + aligned, bufs[i], buf_lens[i]);
+    w = aligned + buf_lens[i];
+  }
+  int rc = rt_store_seal(handle, key);
+  if (rc != 0) {
+    rt_store_abort(handle, key);
+    return -4;
+  }
   return 0;
 }
 

@@ -112,6 +112,11 @@ class ActorHandle:
             raise AttributeError(
                 f"Actor has no method {item!r}; known: {sorted(self._methods)}"
             )
+        # NOT cached on the instance: the proxy holds a strong back-ref
+        # to the handle, so caching would create a handle<->proxy cycle
+        # and delay the owned-actor __del__ termination from refcount
+        # drop to an eventual cyclic-GC pass. The per-call allocation is
+        # noise next to the serialize+pipe work of a method call.
         return ActorMethod(self, item, meta.get("num_returns", 1))
 
     def _submit_method(self, method_name: str, args, kwargs, num_returns=1):

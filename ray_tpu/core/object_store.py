@@ -31,6 +31,7 @@ from .config import config
 from .exceptions import ObjectLostError, ObjectStoreFullError
 from .ids import NodeID, ObjectID
 from .serialization import SerializedObject
+from ..observability import hotpath as _hotpath
 
 _SEG_PREFIX = "rt_"
 
@@ -127,8 +128,9 @@ class SharedMemoryStore:
 
     def _arena_create_write_seal(self, object_id: ObjectID,
                                  obj: SerializedObject, size: int) -> None:
-        """create_object → write_into → seal, spilling + retrying on a
-        full arena exactly like the copying path."""
+        """One-call reserve → C-side copy → seal (``put_frame``),
+        spilling + retrying on a full arena exactly like the copying
+        path. Layout parity with write_into is pinned by tests."""
         from .._native import NativeStoreFull, NativeStoreUnsealed
 
         key = object_id.binary()
@@ -136,22 +138,17 @@ class SharedMemoryStore:
         def attempt() -> bool:
             try:
                 try:
-                    view = self._arena.create_object(key, size)
+                    self._arena.put_frame(key, obj.inband, obj.buffers)
                 except NativeStoreUnsealed:
                     # A prior writer died between create and seal; the
                     # owner serializes same-key writes, so reclaim it.
                     self._arena.abort(key)
-                    view = self._arena.create_object(key, size)
+                    self._arena.put_frame(key, obj.inband, obj.buffers)
             except NativeStoreFull:
                 return False
-            try:
-                obj.write_into(view)
-            except BaseException:
-                self._arena.abort(key)
-                raise
-            finally:
-                view.release()
-            self._arena.seal(key)
+            # Same byte unit as write_into's own count: payload bytes
+            # (inband + buffers), not the padded frame size.
+            _hotpath.count("copy.serialize.write_into", obj.total_bytes())
             return True
 
         if attempt():
@@ -327,6 +324,15 @@ class SharedMemoryStore:
                 f"object of {need} bytes exceeds store capacity {self.capacity}"
             )
         threshold = config().object_spilling_threshold
+        # Logical accounting first: one arena.stats() round-trip per put
+        # was measurable on the 10MB hot path (the first header access
+        # after dirtying a large extent pays a fixed surcharge). The
+        # logical figure can only UNDER-count vs the allocator's truth
+        # (deferred frees, absorbed slivers), and the under-count is
+        # safe: a genuinely full arena still raises NativeStoreFull,
+        # which the put paths catch by spilling and retrying.
+        if self.used + need <= self.capacity * threshold:
+            return
         if self._used_now() + need <= self.capacity * threshold:
             return
         # Spill least-recently-accessed unpinned objects until there is room
@@ -496,26 +502,24 @@ class ShmClient:
         size = obj.frame_bytes()
         if self._arena is not None:
             key = object_id.binary()
+            done = False
             try:
                 try:
-                    view = self._arena.create_object(key, size)
+                    self._arena.put_frame(key, obj.inband, obj.buffers)
+                    done = True
                 except NativeStoreUnsealed:
                     # Prior writer died mid-create; reclaim and retry.
                     self._arena.abort(key)
-                    view = self._arena.create_object(key, size)
+                    self._arena.put_frame(key, obj.inband, obj.buffers)
+                    done = True
             except NativeStoreExists:
                 return size  # idempotent re-put
             except Exception:
-                view = None  # full/unavailable: fall back below
-            if view is not None:
-                try:
-                    obj.write_into(view)
-                except BaseException:
-                    self._arena.abort(key)
-                    raise
-                finally:
-                    view.release()
-                self._arena.seal(key)
+                done = False  # full/unavailable: fall back below
+            if done:
+                # Payload bytes, matching write_into's unit.
+                _hotpath.count("copy.serialize.write_into",
+                               obj.total_bytes())
                 return size
         seg = shared_memory.SharedMemory(
             create=True, size=max(size, 1), name=_segment_name(object_id)

@@ -21,6 +21,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutTimeout
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -43,6 +44,7 @@ from .scheduler import ClusterScheduler, NodeManager, PendingLease
 from .serialization import Serializer
 from .task_spec import SchedulingStrategy, TaskSpec, TaskType
 from ..observability import event_stats as _event_stats
+from ..observability import hotpath as _hotpath
 from .worker_pool import WorkerHandle
 
 
@@ -573,7 +575,8 @@ class Runtime:
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
             try:
                 values.append(fut.result(timeout=remaining))
-            except TimeoutError:
+            except (TimeoutError, _FutTimeout):
+                # futures.TimeoutError is a distinct class before py3.11.
                 raise GetTimeoutError(
                     f"get() timed out after {timeout}s waiting for objects"
                 ) from None
@@ -633,9 +636,12 @@ class Runtime:
         mid-read); daemon-proxy stores already return a private copy."""
         get_pinned = getattr(store, "get_pinned", None)
         if get_pinned is None:
-            return bytes(store.get_buffer(oid))
+            frame = bytes(store.get_buffer(oid))
+            _hotpath.count("copy.store.read_bytes", len(frame))
+            return frame
         buf = get_pinned(oid)
         try:
+            _hotpath.count("copy.store.read_bytes", buf.nbytes)
             return bytes(buf)
         finally:
             buf.release()
@@ -712,8 +718,11 @@ class Runtime:
             return self._create_actor(spec)
         if spec.task_type == TaskType.ACTOR_TASK:
             # Actor pushes resolve args immediately: any buffered producer
-            # must reach the scheduler first.
-            self._flush_submissions()
+            # must reach the scheduler first. (Only when something is
+            # actually buffered — the unconditional flush cost a cv
+            # round-trip on every call of the sync actor hot path.)
+            if self._submit_buf:
+                self._flush_submissions()
             return self._submit_actor_task(spec)
         return self._submit_normal_task(spec)
 
@@ -833,10 +842,20 @@ class Runtime:
         spec = record.spec
         resolved: Dict[int, Any] = {}
         failed_error = None
+        lost_arg = None
         with self._lock:
             for i, oid in enumerate(spec.arg_refs):
                 payload = self._object_entry_payload(oid)
                 if payload is None:
+                    # Arg vanished between deps-ready and dispatch (evicted
+                    # or holder died). Mark it LOST so the retry's
+                    # _schedule_task waits on it AND kicks lineage
+                    # reconstruction, instead of failing the task outright.
+                    entry = self._objects.setdefault(oid, _ObjectEntry())
+                    if entry.status != _ObjStatus.FAILED:
+                        entry.status = _ObjStatus.LOST
+                        entry.location = None
+                        lost_arg = oid
                     failed_error = ObjectLostError(oid, "arg unavailable at dispatch")
                     break
                 if payload[0] == "error":
@@ -849,7 +868,7 @@ class Runtime:
             self._worker_tasks.setdefault(
                 worker.worker_id.binary(), set()).add(spec.task_id)
         if failed_error is not None:
-            self._fail_task(record, failed_error, retryable=False)
+            self._fail_task(record, failed_error, retryable=lost_arg is not None)
             return
         ok = worker.send(("exec", spec.task_id.hex(), {
             "task_type": spec.task_type.value,
@@ -1042,15 +1061,27 @@ class Runtime:
         self.scheduler.submit(lease)
 
     def _actor_creation_done(self, record: _ActorRecord) -> None:
-        with self._lock:
-            record.state = ActorState.ALIVE
-            pending = list(record.pending)
-            record.pending = []
+        # Replay-then-flip: methods buffered while the actor was
+        # PENDING must hit the worker pipe BEFORE any new submission.
+        # Flipping ALIVE first (old behavior) let a concurrent
+        # _submit_actor_task push straight to the pipe mid-replay —
+        # a later call could overtake buffered ones (the
+        # test_actor_method_ordering flake; seq numbers were right,
+        # arrival order wasn't). So: drain pending in batches while the
+        # state still buffers new calls, and flip ALIVE atomically only
+        # once the buffer is observed empty.
+        while True:
+            with self._lock:
+                pending = list(record.pending)
+                record.pending = []
+                if not pending:
+                    record.state = ActorState.ALIVE
+                    break
+            for spec in pending:
+                self._push_actor_task(record, spec)
         self.gcs.update_actor(record.actor_id, ActorState.ALIVE,
                               node_id=record.node.node_id,
                               worker_id=record.worker.worker_id)
-        for spec in pending:
-            self._push_actor_task(record, spec)
         if record.termination_requested:
             # Deferred handle-GC termination: the queued methods above are
             # already in the worker's pipe, so drain_exit runs after them.
@@ -1082,6 +1113,10 @@ class Runtime:
                 self._mark_failed(oid, ActorDiedError(record.actor_id, str(error)))
 
     def _submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        # HOT PATH (one lock round, see _push_actor_task): a sync actor
+        # call submits, pushes, and completes thousands of times per
+        # second; the lock is an RLock, so the nested helpers
+        # (_increment_arg_pins/_mark_failed) are re-entrant and free.
         with self._lock:
             record = self._actors.get(spec.actor_id)
             if record is None:
@@ -1105,48 +1140,51 @@ class Runtime:
                 self._increment_arg_pins(spec)
                 record.pending.append(spec)
                 return refs
-        self._increment_arg_pins(spec)
+            self._increment_arg_pins(spec)
         self._push_actor_task(record, spec)
         return refs
 
     def _push_actor_task(self, record: _ActorRecord, spec: TaskSpec) -> None:
+        """Push one method call straight into the actor worker's pipe.
+
+        Fast path: ONE runtime-lock round covering bookkeeping + arg
+        resolution (was three), and a positional "aexec" frame instead
+        of the generic exec dict — per-call pickling of 9 string keys
+        and a dict shell was measurable at sync-call rates. The worker's
+        reader submits aexec frames directly to the actor's executor
+        (see worker_main._route_aexec)."""
+        resolved: Optional[Dict[int, Any]] = None
+        failed = None
         with self._lock:
             record.in_flight[spec.task_id.binary()] = spec
+            worker = record.worker
             task_record = _TaskRecord(spec, retries_left=spec.max_retries,
-                                      node=record.node, worker=record.worker,
+                                      node=record.node, worker=worker,
                                       state="RUNNING")
             self._tasks[spec.task_id] = task_record
             self._worker_tasks.setdefault(
-                record.worker.worker_id.binary(), set()).add(spec.task_id)
-        resolved: Dict[int, Any] = {}
-        failed = None
-        with self._lock:
-            for i, oid in enumerate(spec.arg_refs):
-                payload = self._object_entry_payload(oid)
-                if payload is None or payload[0] == "error":
-                    failed = (payload[1] if payload else
-                              ObjectLostError(oid, "actor-task arg unavailable"))
-                    break
-                resolved[i] = payload
+                worker.worker_id.binary(), set()).add(spec.task_id)
+            if spec.arg_refs:
+                resolved = {}
+                for i, oid in enumerate(spec.arg_refs):
+                    payload = self._object_entry_payload(oid)
+                    if payload is None or payload[0] == "error":
+                        failed = (payload[1] if payload else
+                                  ObjectLostError(
+                                      oid, "actor-task arg unavailable"))
+                        break
+                    resolved[i] = payload
         if failed is not None:
             with self._lock:
                 record.in_flight.pop(spec.task_id.binary(), None)
             for oid in spec.return_ids():
                 self._mark_failed(oid, failed)
             return
-        ok = record.worker.send(("exec", spec.task_id.hex(), {
-            "task_type": spec.task_type.value,
-            "function_blob": None,
-            "method_name": spec.method_name,
-            "actor_id": spec.actor_id.hex(),
-            "args_frame": spec.args_frame,
-            "resolved_args": resolved,
-            "num_returns": spec.num_returns,
-            "name": spec.describe(),
-            "trace_ctx": spec.trace_ctx,
-        }))
+        ok = worker.send(("aexec", spec.task_id.hex(), spec.actor_id.hex(),
+                          spec.method_name, spec.args_frame, resolved,
+                          spec.num_returns, spec.trace_ctx))
         if not ok:
-            self._handle_worker_death(record.worker)
+            self._handle_worker_death(worker)
 
     @staticmethod
     def _is_shared_hosted(record, worker) -> bool:

@@ -21,6 +21,8 @@ from typing import Any, Callable, List, Optional, Tuple
 
 import cloudpickle
 
+from ..observability import hotpath
+
 _PROTOCOL = 5
 
 
@@ -37,6 +39,15 @@ class DeviceArrayPayload:
         return jax.numpy.asarray(self.data)
 
 
+def _align64(off: int) -> int:
+    """Frame offsets of out-of-band buffers are 64-byte aligned: the
+    bulk memcpy hits an aligned destination, and the numpy arrays that
+    deserialize as zero-copy views get aligned storage (SIMD loads).
+    EVERY frame producer/consumer must use these helpers — hand-computed
+    offsets will misread frames."""
+    return (off + 63) & ~63
+
+
 @dataclass
 class SerializedObject:
     """In-band bytes + out-of-band buffers, ready for the object store."""
@@ -49,15 +60,20 @@ class SerializedObject:
         return len(self.inband) + sum(b.raw().nbytes for b in self.buffers)
 
     def frame_bytes(self) -> int:
-        """Exact size of the flattened frame (header + inband + buffers)."""
-        return (4 + 8 * (1 + len(self.buffers)) + len(self.inband)
-                + sum(b.raw().nbytes for b in self.buffers))
+        """Exact size of the flattened frame (header + inband + padded
+        out-of-band buffers; see _align64)."""
+        off = 4 + 8 * (1 + len(self.buffers)) + len(self.inband)
+        for b in self.buffers:
+            off = _align64(off) + b.raw().nbytes
+        return off
 
     def write_into(self, view: memoryview) -> None:
         """Write the flattened frame directly into a writable buffer —
         the zero-copy put path: each out-of-band buffer memcpys straight
         into the (typically shm-arena-backed) destination with no
-        intermediate bytes object."""
+        intermediate bytes object. Counted as ONE copy regardless of
+        buffer count (hotpath ``copy.serialize.write_into``) — the copy
+        floor for a put, since the source value lives in caller memory."""
         header = [len(self.inband)] + [b.raw().nbytes for b in self.buffers]
         off = 4 + 8 * len(header)
         view[:4] = len(header).to_bytes(4, "little")
@@ -65,15 +81,25 @@ class SerializedObject:
             view[4 + 8 * i: 12 + 8 * i] = h.to_bytes(8, "little")
         view[off: off + len(self.inband)] = self.inband
         off += len(self.inband)
+        nbytes = len(self.inband)
         for b in self.buffers:
             raw = b.raw()  # flat contiguous uint8 view per PickleBuffer.raw
-            view[off: off + raw.nbytes] = raw
-            off += raw.nbytes
+            aligned = _align64(off)
+            if aligned != off:
+                view[off:aligned] = bytes(aligned - off)  # deterministic pad
+            view[aligned: aligned + raw.nbytes] = raw
+            off = aligned + raw.nbytes
+            nbytes += raw.nbytes
+        hotpath.count("copy.serialize.write_into", nbytes)
 
     def to_bytes(self) -> bytes:
-        """Flatten to one contiguous frame: [n][len(inband)][inband][bufs...]."""
+        """Flatten to one contiguous frame: [n][len(inband)][inband][bufs...].
+
+        One EXTRA copy over write_into (the flat bytes intermediate) —
+        only the small-object inline path should ever call this."""
         out = bytearray(self.frame_bytes())
         self.write_into(memoryview(out))
+        hotpath.count("copy.serialize.to_bytes", len(out))
         return bytes(out)
 
 
@@ -87,6 +113,7 @@ def _split_frames(data: memoryview) -> Tuple[memoryview, List[memoryview]]:
     off += sizes[0]
     buffers = []
     for s in sizes[1:]:
+        off = _align64(off)  # buffers are 64B-aligned in the frame
         buffers.append(data[off : off + s])
         off += s
     return inband, buffers
@@ -143,15 +170,98 @@ class _RTPickler(cloudpickle.CloudPickler):
         return super().reducer_override(obj)
 
 
+def _init_fast_types():
+    """Exact types the C pickler serializes both correctly and
+    portably across worker processes: no closures/locals (C pickler
+    would raise — fine), and crucially nothing defined in ``__main__``
+    that C pickle would encode by reference (workers re-import a
+    different __main__ under multiprocessing spawn). Exact-type
+    membership, not isinstance: a subclass's type object itself would
+    pickle by module reference, which may not hold for test-local
+    subclasses."""
+    import numpy as np
+
+    return frozenset((
+        bytes, bytearray, str, int, float, bool, complex, type(None),
+        np.ndarray, np.float32, np.float64, np.int32, np.int64,
+        np.uint8, np.uint32, np.uint64, np.bool_,
+    ))
+
+
+_FAST_TYPES: Optional[frozenset] = None
+_FAST_SCALARS: Optional[frozenset] = None  # _FAST_TYPES minus ndarray
+_STR_ONLY = frozenset((str,))
+_ND_ARRAY: Optional[type] = None
+
+
+def _fast_ok(value: Any, depth: int = 4) -> bool:
+    """True when ``value`` is a tree of _FAST_TYPES over small exact
+    tuples/lists/dicts — the data-plane common case (numpy payloads,
+    token lists, plain arg tuples). Everything else (ObjectRefs,
+    jax.Arrays, user classes, functions) takes the CloudPickler path."""
+    t = value.__class__
+    if t in _FAST_TYPES:
+        if t is not _ND_ARRAY:
+            return True
+        # dtype=object arrays can hide ObjectRefs, whose serialize-side
+        # borrow tracking only the CloudPickler path performs.
+        return value.dtype.hasobject is False
+    if depth <= 0:
+        return False
+    # Flat scalar collections (token lists, float batches) validate at
+    # C speed: frozenset.issuperset(map(type, ...)) iterates without a
+    # Python frame per element. Only short mixed collections take the
+    # per-element recursion — a long mixed list goes to the slow path
+    # rather than paying an O(n) Python scan on top of it.
+    if t is tuple or t is list:
+        if _FAST_SCALARS.issuperset(map(type, value)):
+            return True
+        return len(value) <= 64 and all(_fast_ok(v, depth - 1)
+                                        for v in value)
+    if t is dict:
+        if _STR_ONLY.issuperset(map(type, value.keys())) and \
+                _FAST_SCALARS.issuperset(map(type, value.values())):
+            return True
+        return len(value) <= 64 and all(
+            k.__class__ is str and _fast_ok(v, depth - 1)
+            for k, v in value.items())
+    return False
+
+
 class Serializer:
-    """Pickles values; intercepts ObjectRefs (borrow tracking) and jax.Arrays."""
+    """Pickles values; intercepts ObjectRefs (borrow tracking) and jax.Arrays.
+
+    Two-tier: plain data trees (numpy arrays, scalars, small exact
+    containers) go through the C pickler directly — the Python-class
+    pickler costs 40-50x more per call because ``reducer_override`` +
+    ``persistent_id`` force a Python callback per pickled object, which
+    dominated both small actor-call frames and 10MB put headers.
+    Anything that could contain refs/closures/device arrays takes the
+    full interception path."""
 
     def __init__(self, ref_class=None, actor_handle_class=None):
         self._ref_class = ref_class
         self._actor_handle_class = actor_handle_class
 
     def serialize(self, value: Any) -> SerializedObject:
+        global _FAST_TYPES, _FAST_SCALARS, _ND_ARRAY
+        if _FAST_TYPES is None:
+            import numpy as np
+
+            _ND_ARRAY = np.ndarray
+            types = _init_fast_types()
+            _FAST_SCALARS = types - {_ND_ARRAY}
+            # Publish the guard variable LAST: a concurrent first-use
+            # serialize on another thread must never observe
+            # _FAST_TYPES set while _FAST_SCALARS is still None.
+            _FAST_TYPES = types
         buffers: List[pickle.PickleBuffer] = []
+        if _fast_ok(value):
+            # C fast path: no refs possible in a fast tree, so borrow
+            # tracking has nothing to record.
+            inband = pickle.dumps(value, protocol=_PROTOCOL,
+                                  buffer_callback=buffers.append)
+            return SerializedObject(inband, buffers, [])
         contained: List[Any] = []
 
         def buffer_callback(buf: pickle.PickleBuffer) -> bool:

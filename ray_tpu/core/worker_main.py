@@ -175,7 +175,9 @@ class WorkerRuntime:
                 msgs = frame[1] if frame[0] == "batch" else (frame,)
                 for msg in msgs:
                     kind = msg[0]
-                    if kind == "exec":
+                    if kind == "aexec":
+                        self._route_aexec(msg)
+                    elif kind == "exec":
                         self._route_exec(msg)
                     elif kind == "reply":
                         _, req_id, ok, value = msg
@@ -474,8 +476,11 @@ class WorkerRuntime:
         actor_hex = payload.get("actor_id")
         if actor_hex is None:
             return None
-        group = self._actor_method_groups.get(actor_hex, {}).get(
-            payload.get("method_name"))
+        return self._pick_executor_fast(actor_hex, payload.get("method_name"))
+
+    def _pick_executor_fast(self, actor_hex: str,
+                            method_name) -> Optional[ThreadPoolExecutor]:
+        group = self._actor_method_groups.get(actor_hex, {}).get(method_name)
         if group is not None:
             executor = self._group_executors.get((actor_hex, group))
             if executor is not None:
@@ -515,6 +520,86 @@ class WorkerRuntime:
             with self._route_lock:
                 self._loop_pending += 1
         self._task_queue.put(msg)
+
+    def _route_aexec(self, msg) -> None:
+        """Route a compact actor-call frame: ("aexec", task_id_hex,
+        actor_hex, method_name, args_frame, resolved|None, num_returns,
+        trace_ctx). Same ordering guard as _route_exec; the fallback
+        re-wraps into a legacy exec payload so the loop thread's queue
+        stays uniform (creation-before-method ordering preserved)."""
+        actor_hex = msg[2]
+        with self._route_lock:
+            if self._loop_pending == 0:
+                executor = self._pick_executor_fast(actor_hex, msg[3])
+                if executor is not None:
+                    try:
+                        executor.submit(self._execute_actor_fast, msg)
+                        return
+                    except RuntimeError:
+                        err = TaskError.from_exception(
+                            RuntimeError("worker draining"), msg[3] or "")
+                        self._send(("error", msg[1],
+                                    serialization.dumps(err), True))
+                        return
+            self._loop_pending += 1
+        self._task_queue.put(("exec", msg[1], {
+            "task_type": TaskType.ACTOR_TASK.value,
+            "function_blob": None,
+            "method_name": msg[3],
+            "actor_id": actor_hex,
+            "args_frame": msg[4],
+            "resolved_args": msg[5] or {},
+            "num_returns": msg[6],
+            "name": f"actor.{msg[3]}",
+            "trace_ctx": msg[7],
+        }))
+
+    def _execute_actor_fast(self, msg) -> None:
+        """Execute one aexec frame on the actor's executor thread —
+        the sync-call hot path: no payload dict, no runtime_env check,
+        and tracing contexts only materialize when tracing is on."""
+        (_, task_id_hex, actor_hex, method_name, args_frame,
+         resolved_entries, num_returns, trace_ctx) = msg
+        prev_task = self.current_task_id
+        self.current_task_id = TaskID.from_hex(task_id_hex)
+        try:
+            instance = self._actors.get(actor_hex)
+            if instance is None:
+                raise ActorError(msg="actor instance not found on worker")
+            method = getattr(instance, method_name)
+            resolved = ({i: self._materialize(entry, priority=2)
+                         for i, entry in resolved_entries.items()}
+                        if resolved_entries else {})
+            args, kwargs = self._resolve_args(args_frame, resolved)
+            from ..observability import tracing
+
+            if trace_ctx is not None or tracing.get_tracer().enabled:
+                with tracing.remote_context(trace_ctx), \
+                        tracing.span(f"task.execute actor.{method_name}",
+                                     task_id=task_id_hex):
+                    result = method(*args, **kwargs)
+            else:
+                result = method(*args, **kwargs)
+            import inspect
+
+            if inspect.iscoroutine(result):
+                import asyncio
+
+                loop = self._actor_loops.get(actor_hex)
+                if loop is None:
+                    loop = self._start_actor_loop()
+                    self._actor_loops[actor_hex] = loop
+                result = asyncio.run_coroutine_threadsafe(
+                    result, loop).result()
+            results = self._store_results(task_id_hex, result, num_returns)
+            self._send(("done", task_id_hex, results))
+        except BaseException as e:  # noqa: BLE001 — report, owner decides
+            err = TaskError.from_exception(
+                e, f"actor.{method_name}")
+            self._send(("error", task_id_hex, serialization.dumps(err),
+                        isinstance(e, Exception)))
+        finally:
+            self.current_task_id = prev_task
 
     def _destroy_actor(self, actor_hex: str) -> None:
         """Evict one shared-process actor instance; the worker lives on.

@@ -1,0 +1,49 @@
+"""Hot-path copy/op counters — the data-plane profile ledger.
+
+Counts bulk-byte copies (and other per-op events) at the exact code
+sites that touch object payloads, so benchmarks and tests can PIN the
+copy count of a path instead of inferring it from throughput: a 10MB
+``put`` must be exactly one ``copy.serialize.write_into`` and a shm
+``get`` must be zero copies (the value deserializes as views into the
+arena). Counting is a dict increment (~0.1us) per *operation*, not per
+byte, so the counters stay on in production.
+
+Process-local (each worker has its own table); the microbenchmark reads
+the driver's table, which is where put/get copies happen.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_counts: Dict[str, int] = {}
+_bytes: Dict[str, int] = {}
+
+
+def count(site: str, nbytes: int = 0, n: int = 1) -> None:
+    """Record ``n`` events (optionally carrying ``nbytes`` payload bytes)
+    at a dotted site name, e.g. ``copy.serialize.write_into``."""
+    with _lock:
+        _counts[site] = _counts.get(site, 0) + n
+        if nbytes:
+            _bytes[site] = _bytes.get(site, 0) + nbytes
+
+
+def reset(prefix: str = "") -> None:
+    with _lock:
+        for table in (_counts, _bytes):
+            for k in [k for k in table if k.startswith(prefix)]:
+                del table[k]
+
+
+def breakdown(prefix: str = "") -> Dict[str, int]:
+    """Event counts for sites under ``prefix``."""
+    with _lock:
+        return {k: v for k, v in _counts.items() if k.startswith(prefix)}
+
+
+def byte_breakdown(prefix: str = "") -> Dict[str, int]:
+    with _lock:
+        return {k: v for k, v in _bytes.items() if k.startswith(prefix)}

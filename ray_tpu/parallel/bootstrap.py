@@ -145,6 +145,23 @@ class Bootstrap:
         coordinator = getattr(self, "_coordinator", None)
         if coordinator is None:
             coordinator = self.coordinator_address()
+        # CPU-hosted SPMD (tests / dryruns): the default CPU client has
+        # no cross-process collectives ("Multiprocess computations
+        # aren't implemented on the CPU backend") — select the gloo
+        # implementation. Probe the PLATFORMS CONFIG, not
+        # jax.default_backend(): the latter would initialize backends
+        # before jax.distributed, which is exactly the ordering bug
+        # this guard exists to avoid.
+        try:
+            platforms = jax.config.jax_platforms or ""
+        except AttributeError:
+            platforms = ""
+        if "cpu" in platforms.split(","):
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except (AttributeError, ValueError):
+                pass  # option absent (very old jax) or gloo unavailable
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=self.world_size,

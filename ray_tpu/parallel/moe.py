@@ -19,6 +19,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from .collective import axis_size
 
 
 def router_topk(logits, k: int):
@@ -76,7 +77,7 @@ def moe_ffn_local(x, router_w, w_in, w_out, *, num_experts: int,
     Returns (y [tokens_local, model], aux_loss scalar).
     """
     tokens, model = x.shape
-    ep = jax.lax.axis_size(axis_name) if axis_name else 1
+    ep = axis_size(axis_name) if axis_name else 1
     e_local = num_experts // ep
 
     logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)

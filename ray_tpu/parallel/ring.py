@@ -20,6 +20,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from .collective import axis_size
 
 _NEG_INF = -1e30
 
@@ -35,7 +36,7 @@ def ring_attention_local(q, k, v, axis_name: str = "sp",
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
 
@@ -103,7 +104,7 @@ def ring_flash_attention_local(q, k, v, axis_name: str = "sp",
 
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]

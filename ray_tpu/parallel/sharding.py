@@ -136,6 +136,18 @@ def current_mesh() -> Optional[Mesh]:
     return _CURRENT_MESH[0]
 
 
+def use_mesh(mesh: Mesh):
+    """Version-compat ``jax.set_mesh`` context: the symbol only exists
+    on newer jax; older jax enters the mesh context directly (``with
+    mesh:``), which makes bare PartitionSpecs resolve the same way.
+    ALWAYS use this (not jax.set_mesh) around pjit calls that rely on
+    bare specs."""
+    set_mesh = getattr(jax, "set_mesh", None)
+    if set_mesh is not None:
+        return set_mesh(mesh)
+    return mesh
+
+
 def smap(f, mesh: Mesh, in_specs, out_specs):
     """``shard_map`` with version compat (jax>=0.8 moved it to jax.shard_map
     and renamed check_rep->check_vma)."""

@@ -538,11 +538,17 @@ class Router:
         self._name = deployment_name
         self._max_cq = max_concurrent_queries
         self._replicas: List[Any] = []
+        # Parallel to _replicas: cached actor-id keys, so the pick loop
+        # never re-derives ``_actor_id.binary()`` per replica per
+        # request (an O(replicas) allocation storm at 8 replicas that
+        # helped INVERT handle throughput vs 1 replica).
+        self._keys: List[bytes] = []
         self._version = -1
         self._rr = 0  # sticky pick: index of the previous replica
         self._slack = 16  # see _pick_slot_locked sticky-with-slack
         # keyed by replica actor id (stable across replica-set updates)
         self._inflight: Dict[bytes, int] = {}
+        self._waiters = 0  # blocked assigners; gate for notify_all
         self._lock = threading.Lock()
         self._slot_free = threading.Condition(self._lock)
         self._stop = threading.Event()
@@ -563,12 +569,16 @@ class Router:
                 with self._slot_free:
                     if version != self._version:
                         self._version = version
-                        self._replicas = replicas
+                        self._set_replicas_locked(replicas)
                         self._slot_free.notify_all()
             except Exception:
                 if self._stop.is_set():
                     return
                 time.sleep(0.5)
+
+    def _set_replicas_locked(self, replicas) -> None:
+        self._replicas = replicas
+        self._keys = [r._actor_id.binary() for r in replicas]
 
     def _ensure_replicas(self, timeout: float = 5.0) -> None:
         """First-use bootstrap: snapshot directly (the long-poll only
@@ -583,12 +593,19 @@ class Router:
             with self._slot_free:
                 if version >= self._version and replicas:
                     self._version = version
-                    self._replicas = replicas
+                    self._set_replicas_locked(replicas)
         except Exception:
             pass
 
     def stop(self):
         self._stop.set()
+
+    def stats(self) -> Dict[str, Any]:
+        """Router-local routing state (for tests/diagnostics)."""
+        with self._slot_free:
+            return {"replicas": len(self._replicas),
+                    "sticky_index": self._rr,
+                    "inflight": dict(self._inflight)}
 
     def assign(self, method: Optional[str], args, kwargs):
         return self.assign_with_replica(method, args, kwargs)[0]
@@ -603,11 +620,30 @@ class Router:
         loaded than the least-loaded keeps one worker hot at low load,
         while genuine concurrency (inflight ties broken) still spreads
         by load exactly like the reference's availability-set routing
-        (router.py:221). None when all are at capacity."""
+        (router.py:221). None when all are at capacity.
+
+        REPLICA-LINEAR: the common case is O(1) — when the sticky
+        replica's load is already within ``_slack`` of zero it beats or
+        ties any scan result (best_load >= 0), so no scan runs and the
+        pick cost no longer grows with the replica count. The full
+        least-loaded scan (over cached keys) only runs once the hot
+        replica is loaded beyond the slack — i.e. under saturation,
+        where spreading is the point."""
         n = len(self._replicas)
+        if n == 0:
+            return None
+        if self._rr >= n:
+            self._rr = 0
+        skey = self._keys[self._rr]
+        sload = self._inflight.get(skey, 0)
+        if sload < self._max_cq and sload <= self._slack:
+            # Equivalent to the scan outcome: sload - best_load <= slack
+            # holds for every possible best_load >= 0.
+            self._inflight[skey] = sload + 1
+            return self._replicas[self._rr], skey
         best = best_key = best_load = None
         for idx in range(n):
-            key = self._replicas[idx]._actor_id.binary()
+            key = self._keys[idx]
             load = self._inflight.get(key, 0)
             if load >= self._max_cq:
                 continue
@@ -618,15 +654,19 @@ class Router:
         # Sticky-with-slack: keep the previous replica while its load is
         # within `_slack` of the least loaded; spill beyond. Bursts stay
         # packed on one hot replica (per-actor submission batching +
-        # worker cache locality — spreading a 20-burst across 8 asyncio
-        # replicas HALVED the handle path on a single-core host), while
-        # sustained saturation still spreads by load like the
-        # reference's availability-set routing (router.py:221).
-        if self._rr != best and self._rr < n:
-            skey = self._replicas[self._rr]._actor_id.binary()
-            sload = self._inflight.get(skey, 0)
+        # worker cache locality), while sustained saturation still
+        # spreads by load like the reference's availability-set routing.
+        if self._rr != best:
             if sload < self._max_cq and sload - best_load <= self._slack:
                 best, best_key, best_load = self._rr, skey, sload
+            elif sload < self._max_cq:
+                # Slack-overflow spill: route THIS call to the least
+                # loaded but keep the anchor — moving it handed the
+                # next whole burst to a cold replica (anchor ping-pong
+                # was part of the 8-replica handle inversion). The
+                # anchor only migrates when it is at hard capacity.
+                self._inflight[best_key] = best_load + 1
+                return self._replicas[best], best_key
         self._rr = best
         self._inflight[best_key] = best_load + 1
         return self._replicas[best], best_key
@@ -683,7 +723,11 @@ class Router:
                         raise RuntimeError(
                             f"no replica available for "
                             f"{self._name!r}{detail}")
-                    self._slot_free.wait(min(remaining, 1.0))
+                    self._waiters += 1
+                    try:
+                        self._slot_free.wait(min(remaining, 1.0))
+                    finally:
+                        self._waiters -= 1
             if chosen is None:
                 self._ensure_replicas()
                 continue
@@ -730,11 +774,20 @@ class Router:
                 if remaining <= 0:
                     raise RuntimeError(
                         f"no replica available for {self._name!r}")
-                self._slot_free.wait(min(remaining, 1.0))
+                self._waiters += 1
+                try:
+                    self._slot_free.wait(min(remaining, 1.0))
+                finally:
+                    self._waiters -= 1
             self._ensure_replicas()
 
     def _release(self, key: bytes, n: int = 1) -> None:
         with self._slot_free:
             c = self._inflight.get(key, 0)
             self._inflight[key] = max(0, c - n)
-            self._slot_free.notify_all()
+            if self._waiters:
+                # Gate the wake: _release runs on EVERY request
+                # completion, and an unconditional notify_all was a
+                # futex storm with zero waiters in the common
+                # unsaturated case.
+                self._slot_free.notify_all()

@@ -162,13 +162,13 @@ def build_sharded_train(
 
 
 def _under_mesh(mesh: Mesh, fn):
-    from ..parallel.sharding import set_current_mesh
+    from ..parallel.sharding import set_current_mesh, use_mesh
 
     def _call(target, *args, **kwargs):
         prev = None
         set_current_mesh(mesh)
         try:
-            with jax.set_mesh(mesh):
+            with use_mesh(mesh):
                 return target(*args, **kwargs)
         finally:
             set_current_mesh(prev)

@@ -1,0 +1,55 @@
+"""bench.py --smoke: the bench scenarios can't bitrot between rounds.
+
+Runs the real bench entrypoint in a subprocess (it owns its runtime and
+serve instance) with BENCH_SMOKE_FAST=1 — tiny windows, every scenario
+code path: core microbench (paired actor-vs-task + put-vs-memcpy ratios,
+copy counts) and the mixed HTTP + direct-handle + streaming stage with
+p50/p99 latency output.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_runs_all_stages():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_SMOKE_FAST"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=400, env=env, cwd=REPO)
+    line = next((ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("RESULT::")), None)
+    assert line is not None, (
+        f"no RESULT:: line rc={proc.returncode}\n"
+        f"stdout: {proc.stdout[-800:]}\nstderr: {proc.stderr[-800:]}")
+    result = json.loads(line[len("RESULT::"):])
+
+    assert "core_microbench_error" not in result, result
+    micro = result["core_microbench"]
+    # The acceptance-criteria keys must exist and be sane.
+    assert micro["1_1_actor_calls_sync"] > 0
+    assert micro["single_client_tasks_sync"] > 0
+    assert micro["actor_vs_task_sync"] > 0
+    assert 0 < micro["put_large_(10MB)_vs_memcpy"] <= 2.0
+    # Copy-count profile: a 10MB put is exactly ONE frame write and a
+    # get is zero copies (zero-copy views out of the arena).
+    assert micro["put_large_(10MB)_copies_per_op"] == 1.0
+    assert micro["put_large_(10MB)_flatten_copies_per_op"] == 0.0
+    assert micro["get_large_(10MB)_copies_per_op"] == 0
+
+    assert "serve_mixed_error" not in result, result
+    mixed = result["serve_mixed"]
+    assert "errors" not in mixed, mixed
+    # Every traffic class moved AND reported tail latency.
+    for klass in ("http", "handle"):
+        assert mixed[f"{klass}_reqs_per_s"] > 0, mixed
+        assert mixed[f"{klass}_p50_ms"] > 0, mixed
+        assert mixed[f"{klass}_p99_ms"] >= mixed[f"{klass}_p50_ms"], mixed
+    assert mixed["stream_tokens_per_s"] > 0, mixed
+    assert mixed["stream_first_chunk_p99_ms"] >= \
+        mixed["stream_first_chunk_p50_ms"]
