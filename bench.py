@@ -1012,6 +1012,54 @@ def bench_ppo(on_tpu: bool) -> dict:
     }
 
 
+def scrape_telemetry(port: int = 18269) -> dict:
+    """Mid-bench ``/metrics`` scrape: start the dashboard against the
+    live runtime, pull the Prometheus text, and record selected
+    runtime/serve series into the bench JSON — so the telemetry plane
+    (worker->head shipping + instrumentation) can't bitrot silently
+    between rounds."""
+    import urllib.request
+
+    from ray_tpu.core.config import config
+    from ray_tpu.observability import start_dashboard, stop_dashboard
+
+    # One worker flush interval (+margin) so the latest worker-side
+    # series land — derived from config, not hardcoded, so a non-default
+    # RT_METRICS_REPORT_INTERVAL_MS doesn't make the scrape race ahead
+    # of the flushers.
+    time.sleep(config().metrics_report_interval_ms / 1000.0 + 0.5)
+    start_dashboard(port=port)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=15) as r:
+            text = r.read().decode()
+    finally:
+        stop_dashboard()
+
+    def total(metric: str) -> float:
+        s = 0.0
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+            if name == metric:
+                s += float(line.rsplit(" ", 1)[1])
+        return round(s, 3)
+
+    return {
+        "rt_tasks_submitted_total": total("rt_tasks_submitted"),
+        "rt_tasks_finished_total": total("rt_tasks_finished"),
+        "rt_task_latency_seconds_count": total(
+            "rt_task_latency_seconds_count"),
+        "rt_workers_alive": total("rt_workers_alive"),
+        "rt_actors_alive": total("rt_actors_alive"),
+        "rt_serve_requests_total": total("rt_serve_requests"),
+        "rt_serve_replicas": total("rt_serve_replicas"),
+        "rt_serve_request_latency_count": total(
+            "rt_serve_request_latency_seconds_count"),
+    }
+
+
 def smoke() -> dict:
     """``bench.py --smoke``: tiny-N versions of the host-plane bench
     scenarios (seconds, not minutes) so the bench code paths — core
@@ -1038,6 +1086,12 @@ def smoke() -> dict:
         result["serve_mixed"] = bench_serve_mixed(smoke=True)
     except Exception as e:  # noqa: BLE001
         result["serve_mixed_error"] = repr(e)[:300]
+    # Mid-bench scrape while the runtime is still up: the stages above
+    # must have left their marks in the cluster /metrics.
+    try:
+        result["telemetry_scrape"] = scrape_telemetry()
+    except Exception as e:  # noqa: BLE001
+        result["telemetry_scrape_error"] = repr(e)[:300]
     try:
         import ray_tpu as rt
 

@@ -122,6 +122,12 @@ _define("worker_redirect_logs", bool, True,
         "Redirect worker stdout/stderr to session log files tailed by "
         "the log monitor.")
 _define("metrics_report_interval_ms", int, 1000, "Metrics flush interval.")
+_define("telemetry_enabled", bool, True,
+        "Cluster telemetry plane: runtime metric instrumentation plus "
+        "per-process metric-delta/span shipping to the head every "
+        "metrics_report_interval_ms (reference: _private/metrics_agent.py "
+        "per-node agent -> dashboard aggregation). 0 disables for "
+        "overhead A/B runs.")
 _define("event_log_max_bytes", int, 64 * 1024**2, "Structured event log cap.")
 _define("debug_dump_period_ms", int, 10_000,
         "Period for debug-state dumps (reference: "

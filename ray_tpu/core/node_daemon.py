@@ -34,7 +34,12 @@ from typing import Dict, Optional
 
 from .config import config
 from .ids import NodeID, WorkerID
-from .node_protocol import ChunkAssembler, FrameConn, chunk_frames
+from .node_protocol import (
+    TELEMETRY_FRAME,
+    ChunkAssembler,
+    FrameConn,
+    chunk_frames,
+)
 from .object_store import SharedMemoryStore
 from .worker_pool import WorkerPool
 from ..observability import event_stats as _event_stats
@@ -84,6 +89,38 @@ class NodeDaemon:
         self._locate_pending: Dict[int, "_LocateWaiter"] = {}
         self._locate_ids = 0
         self._locate_lock = threading.Lock()
+        # Telemetry plane: this DAEMON process's own metric deltas and
+        # spans ship to the head over the control connection, tagged with
+        # this node (workers under this daemon ship through their pipes
+        # and are relayed verbatim by _relay_from_worker). The daemon
+        # samples its shm-store usage into the object_store_bytes gauge
+        # before each flush — the head cannot reach this store cheaply.
+        # Started from run() AFTER register_node goes out: the head's
+        # accept loop closes any connection whose FIRST frame is not the
+        # registration.
+
+    def _telemetry_loop(self) -> None:
+        from ..observability.metrics import core_metrics
+        from ..observability.telemetry import TelemetryExporter
+
+        node_hex = self.node_id.hex()[:8]
+        exporter = TelemetryExporter(node=node_hex,
+                                     proc=f"daemon {node_hex}")
+        store_gauge = core_metrics()["object_store_bytes"]
+        interval = max(0.05, config().metrics_report_interval_ms / 1000.0)
+        while not self._stopped.wait(interval):
+            try:
+                # Explicit node tag: gauges keep the producer's tags
+                # through absorb (a restarted daemon overwrites its own
+                # series instead of minting a stale per-worker one).
+                store_gauge.set(
+                    float(self.store.stats().get("used_bytes", 0)),
+                    tags={"node": node_hex})
+                payload = exporter.collect()
+                if payload is not None:
+                    self.conn.send((TELEMETRY_FRAME, payload))
+            except Exception:  # noqa: BLE001 — telemetry never kills a node
+                pass
 
     # -- worker plane ------------------------------------------------------
     def _relay_from_worker(self, worker, msg) -> None:
@@ -122,6 +159,9 @@ class NodeDaemon:
         info["object_addr"] = self.object_server.address
         self.conn.send(("register_node", self.node_id.binary(),
                         os.getpid(), info))
+        if config().telemetry_enabled:
+            threading.Thread(target=self._telemetry_loop, daemon=True,
+                             name="rt-daemon-telemetry").start()
         try:
             while not self._stopped.is_set():
                 msg = self.conn.recv()

@@ -21,6 +21,13 @@ from typing import Any, Callable, Optional
 # ObjectManager chunk size, object_manager.h).
 CHUNK_SIZE = 4 * 1024 * 1024
 
+# Fire-and-forget telemetry frames ("telemetry", payload) ride the same
+# duplex connection as control traffic: daemon -> head carries the
+# daemon process's metric deltas + spans; worker telemetry relays inside
+# the usual ("from_worker", wid, msg) envelope (reference: the per-node
+# metrics agent reporting to the dashboard head).
+TELEMETRY_FRAME = "telemetry"
+
 
 class FrameConn:
     """Thread-safe framed pickle connection over a socket."""

@@ -591,11 +591,16 @@ class MemoryStore:
 
     def __init__(self):
         self._values: Dict[ObjectID, Tuple[bytes, tuple]] = {}
+        self._used_bytes = 0
         self._lock = threading.Lock()
 
     def put(self, object_id: ObjectID, frame: bytes) -> None:
         with self._lock:
+            prev = self._values.get(object_id)
+            if prev is not None:
+                self._used_bytes -= len(prev[0])
             self._values[object_id] = (frame, ())
+            self._used_bytes += len(frame)
 
     def get(self, object_id: ObjectID) -> Optional[bytes]:
         with self._lock:
@@ -608,8 +613,16 @@ class MemoryStore:
 
     def delete(self, object_id: ObjectID) -> None:
         with self._lock:
-            self._values.pop(object_id, None)
+            entry = self._values.pop(object_id, None)
+            if entry is not None:
+                self._used_bytes -= len(entry[0])
 
     def size(self) -> int:
         with self._lock:
             return len(self._values)
+
+    def stats(self) -> dict:
+        """Same shape as SharedMemoryStore.stats (telemetry gauge feed)."""
+        with self._lock:
+            return {"num_objects": len(self._values),
+                    "used_bytes": self._used_bytes}

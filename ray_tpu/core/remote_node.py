@@ -25,7 +25,7 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from .ids import NodeID, ObjectID, PlacementGroupID, WorkerID
-from .node_protocol import ChunkAssembler, FrameConn
+from .node_protocol import TELEMETRY_FRAME, ChunkAssembler, FrameConn
 from .scheduler import NodeManager, ResourceLedger
 
 
@@ -454,6 +454,13 @@ class RemoteNode:
         if kind == "locate_object":
             if self._on_locate is not None:
                 self._on_locate(self, msg[1], msg[2])
+            return
+        if kind == TELEMETRY_FRAME:
+            # The daemon process's own metric deltas + spans (workers
+            # under it relay theirs via "from_worker" like any message).
+            from ..observability import telemetry as _telemetry
+
+            _telemetry.absorb(msg[1])
             return
         if kind == "worker_started":
             self.pool._on_worker_started(msg[1], msg[2] if len(msg) > 2

@@ -145,6 +145,23 @@ class Runtime:
 
             tracing.enable()
             self._env.setdefault("RT_TRACING_ENABLED", "1")
+        # Core runtime metrics (reference: stats/metric_defs.cc wired
+        # through the core worker): counters + tag KEYS cached once —
+        # the submit path is hot, so no per-call dict build/sort.
+        # None when the telemetry plane is disabled (overhead A/B).
+        if config().telemetry_enabled:
+            from ..observability.metrics import core_metrics
+
+            self._metrics: Optional[Dict[str, Any]] = core_metrics()
+            self._ctr_submitted = self._metrics["tasks_submitted"]
+            self._ctr_finished = self._metrics["tasks_finished"]
+            self._key_task = (("type", "task"),)
+            self._key_actor = (("type", "actor"),)
+            self._key_creation = (("type", "actor_creation"),)
+            self._finished_keys: Dict[tuple, tuple] = {}
+        else:
+            self._metrics = None
+            self._ctr_submitted = self._ctr_finished = None
         # Session log dir: workers redirect stdout/stderr there; the log
         # monitor tails the files and republishes to the driver
         # (reference: log_monitor.py + session_latest/logs layout).
@@ -726,7 +743,31 @@ class Runtime:
             return self._submit_actor_task(spec)
         return self._submit_normal_task(spec)
 
+    def _task_finished(self, record: _TaskRecord, state: str) -> None:
+        """Count a task reaching DONE/FAILED, node-tagged when placed.
+        Tag keys are interned per (state, node) — this runs on the reply
+        path of every sync call."""
+        if self._ctr_finished is None:
+            return
+        node = record.node
+        node_hex = None
+        if node is not None:
+            node_hex = getattr(node, "_telemetry_hex", None)
+            if node_hex is None:
+                node_hex = node.node_id.hex()[:8]
+                node._telemetry_hex = node_hex
+        key = self._finished_keys.get((state, node_hex))
+        if key is None:
+            pairs = [("state", state)]
+            if node_hex is not None:
+                pairs.append(("node", node_hex))
+            key = tuple(sorted(pairs))
+            self._finished_keys[(state, node_hex)] = key
+        self._ctr_finished.inc_key(key)
+
     def _submit_normal_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        if self._ctr_submitted is not None:
+            self._ctr_submitted.inc_key(self._key_task)
         record = _TaskRecord(spec, retries_left=spec.max_retries)
         return_refs = [ObjectRef(oid) for oid in spec.return_ids()]
         with self._lock:
@@ -890,6 +931,7 @@ class Runtime:
     # ------------------------------------------------ completions & failures
     def _complete_task(self, record: _TaskRecord, results: List[tuple]) -> None:
         spec = record.spec
+        self._task_finished(record, "DONE")
         with self._lock:
             record.state = "DONE"
             if record.worker is not None:
@@ -971,6 +1013,7 @@ class Runtime:
             self._schedule_task(record)
             return
         record.state = "FAILED"
+        self._task_finished(record, "FAILED")
         for oid in spec.return_ids():
             self._mark_failed(oid, error)
         self._decrement_arg_pins(spec)
@@ -1012,6 +1055,8 @@ class Runtime:
 
     # --------------------------------------------------------------- actors
     def _create_actor(self, spec: TaskSpec) -> List[ObjectRef]:
+        if self._ctr_submitted is not None:
+            self._ctr_submitted.inc_key(self._key_creation)
         actor_id = spec.actor_id
         record = _ActorRecord(
             actor_id, spec, restarts_left=spec.max_restarts,
@@ -1117,6 +1162,8 @@ class Runtime:
         # call submits, pushes, and completes thousands of times per
         # second; the lock is an RLock, so the nested helpers
         # (_increment_arg_pins/_mark_failed) are re-entrant and free.
+        if self._ctr_submitted is not None:
+            self._ctr_submitted.inc_key(self._key_actor)
         with self._lock:
             record = self._actors.get(spec.actor_id)
             if record is None:
@@ -1290,6 +1337,15 @@ class Runtime:
         kind = msg[0]
         if kind == "register":
             return
+        if kind == "telemetry":
+            # Worker flusher payload (metric deltas + finished spans):
+            # merge into the head registry/timeline. Same handler for
+            # head-local workers and daemon-relayed ones — the payload
+            # carries its own node/worker identity.
+            from ..observability import telemetry as _telemetry
+
+            _telemetry.absorb(msg[1])
+            return
         if kind == "revoked":
             # Reply to the revoke we sent when this worker blocked:
             # these tasks were still queued (never started) in the
@@ -1314,6 +1370,7 @@ class Runtime:
                 actor = self._actors.get(record.spec.actor_id)
                 with self._lock:
                     record.state = "DONE"
+                self._task_finished(record, "DONE")
                 if actor is not None:
                     self._actor_creation_done(actor)
                     if not actor.creation_pins_released:
@@ -1338,6 +1395,7 @@ class Runtime:
             if record is None:
                 return
             if record.spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                self._task_finished(record, "FAILED")
                 actor = self._actors.get(record.spec.actor_id)
                 if actor is not None:
                     self._actor_creation_failed(actor, error)
@@ -1353,6 +1411,7 @@ class Runtime:
                         if assigned is not None:
                             assigned.discard(task_id)
                 record.state = "FAILED"
+                self._task_finished(record, "FAILED")
                 for oid in record.spec.return_ids():
                     self._mark_failed(oid, error)
             else:
@@ -1399,6 +1458,7 @@ class Runtime:
 
     def _complete_actor_task(self, record: _TaskRecord, results) -> None:
         spec = record.spec
+        self._task_finished(record, "DONE")
         with self._lock:
             record.state = "DONE"
             if record.worker is not None:

@@ -19,6 +19,7 @@ import os
 import queue
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Dict, Optional
@@ -83,6 +84,25 @@ class WorkerRuntime:
         self._sender_thread = threading.Thread(
             target=self._sender_loop, daemon=True, name="rt-worker-sender")
         self._sender_thread.start()
+        # Telemetry plane (reference: per-node metrics agent): a flusher
+        # ships this process's metric deltas + finished spans to the head
+        # every metrics_report_interval_ms over the existing pipe, plus a
+        # final flush at clean exit (run_task_loop teardown).
+        from .config import config as _config
+
+        self._telemetry_exporter = None
+        self._task_latency = None
+        if _config().telemetry_enabled:
+            from ..observability.metrics import core_metrics
+            from ..observability.telemetry import TelemetryExporter
+
+            self._task_latency = core_metrics()["task_latency_s"]
+            self._telemetry_exporter = TelemetryExporter(
+                node=node_id_hex[:8], worker=worker_id_hex[:8],
+                proc=f"worker {worker_id_hex[:8]}")
+            threading.Thread(
+                target=self._telemetry_loop, daemon=True,
+                name="rt-worker-telemetry").start()
         # Borrower protocol (reference_count.h borrower reports): every ref
         # held in this worker pins the object at the owner; GC of the local
         # ref releases the pin via a fire-and-forget message.
@@ -146,6 +166,24 @@ class WorkerRuntime:
                 # would hang its callers forever — die loudly so the
                 # owner's death path fails/retries our tasks.
                 os._exit(1)
+
+    def _telemetry_loop(self) -> None:
+        from .config import config as _config
+
+        interval = max(0.05, _config().metrics_report_interval_ms / 1000.0)
+        while not self._shutdown.wait(interval):
+            self._flush_telemetry()
+
+    def _flush_telemetry(self) -> None:
+        exporter = self._telemetry_exporter
+        if exporter is None:
+            return
+        try:
+            payload = exporter.collect()
+            if payload is not None:
+                self._send(("telemetry", payload))
+        except Exception:  # noqa: BLE001 — telemetry must never kill work
+            pass
 
     def flush_outbound(self, timeout: float = 5.0) -> None:
         """Block until every queued outbound message hit the pipe (or
@@ -369,6 +407,7 @@ class WorkerRuntime:
         prev_task = self.current_task_id
         self.current_task_id = TaskID.from_hex(task_id_hex)
         env_undo = None
+        exec_start = time.perf_counter()
         try:
             if payload.get("runtime_env"):
                 from ..runtime_env import apply_runtime_env
@@ -460,6 +499,8 @@ class WorkerRuntime:
                 from ..runtime_env import restore_runtime_env
 
                 restore_runtime_env(env_undo)
+            if self._task_latency is not None:
+                self._task_latency.observe(time.perf_counter() - exec_start)
             self.current_task_id = prev_task
 
     def _start_actor_loop(self):
@@ -562,6 +603,7 @@ class WorkerRuntime:
          resolved_entries, num_returns, trace_ctx) = msg
         prev_task = self.current_task_id
         self.current_task_id = TaskID.from_hex(task_id_hex)
+        exec_start = time.perf_counter()
         try:
             instance = self._actors.get(actor_hex)
             if instance is None:
@@ -599,6 +641,8 @@ class WorkerRuntime:
             self._send(("error", task_id_hex, serialization.dumps(err),
                         isinstance(e, Exception)))
         finally:
+            if self._task_latency is not None:
+                self._task_latency.observe(time.perf_counter() - exec_start)
             self.current_task_id = prev_task
 
     def _destroy_actor(self, actor_hex: str) -> None:
@@ -668,7 +712,16 @@ class WorkerRuntime:
             for ex in (list(self._actor_executors.values())
                        + list(self._group_executors.values())):
                 ex.shutdown(wait=True)
-            self.flush_outbound()
+        # Final telemetry flush AFTER the executors drained, so the last
+        # tasks' latency observations and spans ship before the process
+        # exits (a worker that finishes and exits between periodic
+        # flushes must still appear in the head's timeline/metrics).
+        # collect() consumes the deltas, so the outbound drain runs on
+        # BOTH exit paths — bounded short on hard exit, where the owner
+        # may already have torn the pipe down.
+        self._flush_telemetry()
+        self.flush_outbound(
+            timeout=5.0 if not self._shutdown.is_set() else 1.0)
         self.shm.close()
 
 

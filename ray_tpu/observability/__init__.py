@@ -8,6 +8,7 @@ from .dashboard import Dashboard, start_dashboard, stop_dashboard
 from .events import EventLog, Severity, emit, global_event_log
 from .metrics import Counter, Gauge, Histogram, core_metrics, registry
 from .event_stats import EventStats, global_event_stats
+from .telemetry import TelemetryExporter, refresh_cluster_gauges
 from .state import (
     actor_detail,
     cluster_status,
@@ -29,6 +30,7 @@ __all__ = [
     "cluster_status", "core_metrics", "emit", "event_loop_stats",
     "global_event_log", "global_event_stats",
     "list_actors", "list_nodes", "list_objects", "list_placement_groups",
-    "list_tasks", "list_workers", "record_span", "registry",
-    "start_dashboard", "stop_dashboard", "summarize_tasks", "timeline",
+    "list_tasks", "list_workers", "record_span", "refresh_cluster_gauges",
+    "registry", "start_dashboard", "stop_dashboard", "summarize_tasks",
+    "TelemetryExporter", "timeline",
 ]

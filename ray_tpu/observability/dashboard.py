@@ -17,8 +17,20 @@ import threading
 from typing import Optional
 
 from . import state as state_api
+from . import telemetry
 from .events import global_event_log
 from .metrics import registry
+
+
+def _serve_status() -> dict:
+    """``/api/serve``: deployment/router snapshot (reference: the serve
+    dashboard module). Lazy import — serve may never have been loaded."""
+    try:
+        from ..serve.api import serve_status_snapshot
+
+        return serve_status_snapshot()
+    except Exception as e:  # noqa: BLE001 — endpoint must answer
+        return {"running": False, "error": str(e), "deployments": {}}
 
 
 def node_stats() -> dict:
@@ -85,7 +97,7 @@ svg.spark{background:#fff;border:1px solid #ddd;border-radius:4px}
 <main><div id="err"></div><div id="detail"></div><div id="view"></div></main>
 <script>
 const TABS = ["overview","nodes","actors","tasks","objects","workers",
-  "placement_groups","jobs","events","event_stats"];
+  "placement_groups","jobs","serve","events","event_stats"];
 // Client-side metric history for the sparklines (one poll per refresh).
 const hist = {running:[], total:[], load:[], mem:[]};
 function esc(v){
@@ -213,6 +225,7 @@ class Dashboard:
             "/api/node_stats": node_stats,
             "/api/jobs": state_api.list_jobs,
             "/api/event_stats": state_api.event_loop_stats,
+            "/api/serve": _serve_status,
         }
 
         class Handler(BaseHTTPRequestHandler):
@@ -234,6 +247,12 @@ class Dashboard:
                     self.wfile.write(b"success")
                     return
                 if path == "/metrics":
+                    # Sample cluster gauges (actors/workers alive, store
+                    # bytes) at scrape time so they can't go stale.
+                    try:
+                        telemetry.refresh_cluster_gauges()
+                    except Exception:  # noqa: BLE001 — scrape anyway
+                        pass
                     body = registry.prometheus_text().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")

@@ -10,12 +10,55 @@ text format over HTTP.
 from __future__ import annotations
 
 import bisect
+import re
 import threading
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 _TagKey = Tuple[Tuple[str, str], ...]
+
+# Prometheus line-format rules: metric names admit [a-zA-Z0-9_:], label
+# names only [a-zA-Z0-9_]; label VALUES are free-form but must escape
+# backslash, double-quote and newline.
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _sanitize_name(name: str) -> str:
+    safe = _NAME_BAD.sub("_", name)
+    if not safe or safe[0].isdigit():
+        safe = "_" + safe
+    return safe
+
+
+def _sanitize_label(name: str) -> str:
+    safe = _LABEL_BAD.sub("_", name)
+    if not safe or safe[0].isdigit():
+        safe = "_" + safe
+    return safe
+
+
+def _escape_label_value(value) -> str:
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt_num(v) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_labels(pairs) -> str:
+    body = ",".join(f'{_sanitize_label(k)}="{_escape_label_value(v)}"'
+                    for k, v in pairs)
+    return "{" + body + "}" if body else ""
 
 
 class Metric:
@@ -28,8 +71,20 @@ class Metric:
         registry.register(self)
 
     def _tags_key(self, tags: Optional[Dict[str, str]]) -> _TagKey:
-        tags = tags or {}
+        if not tags:
+            return ()
         return tuple(sorted(tags.items()))
+
+    def _series(self) -> dict:  # overridden where the store differs
+        return self._values  # type: ignore[attr-defined]
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._series())
+
+    def has_series(self, key: _TagKey) -> bool:
+        with self._lock:
+            return key in self._series()
 
 
 class Counter(Metric):
@@ -41,6 +96,13 @@ class Counter(Metric):
             tags: Optional[Dict[str, str]] = None) -> None:
         with self._lock:
             self._values[self._tags_key(tags)] += value
+
+    def inc_key(self, key: _TagKey, value: float = 1.0) -> None:
+        """Hot-path increment with a PREcomputed tag key (skips the
+        per-call dict build + sort — the runtime submit/finish paths
+        run at sync-call rates)."""
+        with self._lock:
+            self._values[key] += value
 
     def collect(self):
         with self._lock:
@@ -55,6 +117,11 @@ class Gauge(Metric):
     def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
         with self._lock:
             self._values[self._tags_key(tags)] = value
+
+    def set_key(self, key: _TagKey, value: float) -> None:
+        """Hot-path set with a precomputed tag key (router per-request)."""
+        with self._lock:
+            self._values[key] = value
 
     def collect(self):
         with self._lock:
@@ -74,14 +141,41 @@ class Histogram(Metric):
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
-        key = self._tags_key(tags)
+        self.observe_key(self._tags_key(tags), value)
+
+    def observe_key(self, key: _TagKey, value: float,
+                    count: int = 1) -> None:
+        """Hot-path observe with a precomputed tag key; ``count`` folds
+        a coalesced batch of identical observations into one lock round."""
         with self._lock:
             if key not in self._counts:
                 self._counts[key] = [0] * (len(self.boundaries) + 1)
             idx = bisect.bisect_left(self.boundaries, value)
-            self._counts[key][idx] += 1
-            self._sums[key] += value
-            self._totals[key] += 1
+            self._counts[key][idx] += count
+            self._sums[key] += value * count
+            self._totals[key] += count
+
+    def _series(self) -> dict:
+        return self._counts
+
+    def merge_delta(self, delta: dict,
+                    tags: Optional[Dict[str, str]] = None) -> None:
+        """Fold a remote histogram delta ({"buckets", "sum", "count"},
+        as produced by the telemetry exporter) into this series. A
+        boundary mismatch (different config between processes) lumps the
+        whole delta into the +Inf bucket rather than mis-binning."""
+        key = self._tags_key(tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            buckets = delta.get("buckets") or []
+            if len(buckets) == len(counts):
+                for i, c in enumerate(buckets):
+                    counts[i] += c
+            else:
+                counts[-1] += int(delta.get("count", 0))
+            self._sums[key] += float(delta.get("sum", 0.0))
+            self._totals[key] += int(delta.get("count", 0))
 
     def collect(self):
         with self._lock:
@@ -111,30 +205,32 @@ class MetricsRegistry:
         return {m.name: m.collect() for m in metrics}
 
     def prometheus_text(self) -> str:
-        """Prometheus exposition format (reference: prometheus_exporter.py)."""
+        """Prometheus exposition format (reference: prometheus_exporter.py).
+
+        Strictly line-format clean: metric/label names sanitized with one
+        rule everywhere, label values escaped, and the open histogram
+        bucket labeled ``le="+Inf"`` (the spec spelling — a bare ``inf``
+        is rejected by prometheus scrapers)."""
         lines = []
         for name, (kind, data) in sorted(self.collect_all().items()):
-            safe = name.replace(".", "_").replace("-", "_")
+            safe = _sanitize_name(name)
             lines.append(f"# TYPE {safe} "
                          f"{'counter' if kind == 'counter' else 'gauge' if kind == 'gauge' else 'histogram'}")
             if kind in ("counter", "gauge"):
                 for tags, value in data.items():
-                    label = ",".join(f'{k}="{v}"' for k, v in tags)
-                    label = "{" + label + "}" if label else ""
-                    lines.append(f"{safe}{label} {value}")
+                    lines.append(f"{safe}{_fmt_labels(tags)} {_fmt_num(value)}")
             else:
                 for tags, h in data.items():
-                    base = ",".join(f'{k}="{v}"' for k, v in tags)
                     metric = self._metrics.get(name)
                     cumulative = 0
-                    for b, c in zip(metric.boundaries + [float("inf")],
-                                    h["buckets"]):
+                    bounds = [_fmt_num(b) for b in metric.boundaries]
+                    bounds.append("+Inf")
+                    for b, c in zip(bounds, h["buckets"]):
                         cumulative += c
-                        le = f'le="{b}"'
-                        lbl = "{" + (base + "," if base else "") + le + "}"
+                        lbl = _fmt_labels(list(tags) + [("le", b)])
                         lines.append(f"{safe}_bucket{lbl} {cumulative}")
-                    lbl = "{" + base + "}" if base else ""
-                    lines.append(f"{safe}_sum{lbl} {h['sum']}")
+                    lbl = _fmt_labels(tags)
+                    lines.append(f"{safe}_sum{lbl} {_fmt_num(h['sum'])}")
                     lines.append(f"{safe}_count{lbl} {h['count']}")
         return "\n".join(lines) + "\n"
 
@@ -145,6 +241,23 @@ class MetricsRegistry:
 
 registry = MetricsRegistry()
 
+
+_create_lock = threading.Lock()
+
+
+def get_or_create(cls, name: str, *args, **kwargs):
+    """ATOMIC get-or-construct by name: reuse the registered metric when
+    its type matches, else construct (which registers). Every lazy
+    factory (core/serve) AND the telemetry absorber route through here
+    under one lock — racing constructions would otherwise ``register``-
+    overwrite each other, silently dropping every series the loser had
+    merged (or leaving a caller holding an unregistered orphan)."""
+    with _create_lock:
+        existing = registry.get(name)
+        if type(existing) is cls:
+            return existing
+        return cls(name, *args, **kwargs)
+
 # -- core runtime metrics (reference: stats/metric_defs.cc subset) -----------
 
 _core_lock = threading.Lock()
@@ -154,14 +267,18 @@ _core: Dict[str, Metric] = {}
 def core_metrics() -> Dict[str, Metric]:
     with _core_lock:
         if not _core:
-            _core["tasks_submitted"] = Counter(
-                "rt_tasks_submitted", "Tasks submitted", ("type",))
-            _core["tasks_finished"] = Counter(
-                "rt_tasks_finished", "Tasks finished", ("state",))
-            _core["task_latency_s"] = Histogram(
-                "rt_task_latency_seconds", "Task execution wall time")
-            _core["object_store_bytes"] = Gauge(
-                "rt_object_store_bytes", "Per-node store usage", ("node",))
-            _core["actors_alive"] = Gauge("rt_actors_alive", "Live actors")
-            _core["workers_alive"] = Gauge("rt_workers_alive", "Live workers")
+            _core["tasks_submitted"] = get_or_create(
+                Counter, "rt_tasks_submitted", "Tasks submitted", ("type",))
+            _core["tasks_finished"] = get_or_create(
+                Counter, "rt_tasks_finished", "Tasks finished", ("state",))
+            _core["task_latency_s"] = get_or_create(
+                Histogram, "rt_task_latency_seconds",
+                "Task execution wall time")
+            _core["object_store_bytes"] = get_or_create(
+                Gauge, "rt_object_store_bytes", "Per-node store usage",
+                ("node",))
+            _core["actors_alive"] = get_or_create(
+                Gauge, "rt_actors_alive", "Live actors")
+            _core["workers_alive"] = get_or_create(
+                Gauge, "rt_workers_alive", "Live workers")
         return _core

@@ -333,24 +333,43 @@ def cluster_status() -> str:
 
 # -- timeline (reference: ray.timeline -> chrome://tracing JSON) -------------
 
-_events: List[Dict[str, Any]] = []
-_events_lock = None
+import threading as _threading
+from collections import deque as _deque
+
+# Bounded: an app recording spans forever must not grow the head process
+# without limit; and the buffer is written from many threads (app code,
+# telemetry absorb callers), so the lock is real, not a placeholder.
+_EVENTS_MAX = 100_000
+_events: _deque = _deque(maxlen=_EVENTS_MAX)
+_events_lock = _threading.Lock()
 
 
 def record_span(name: str, category: str, start_s: float, end_s: float,
                 pid: int = 0, tid: int = 0, args: Optional[dict] = None):
-    _events.append({
-        "name": name, "cat": category, "ph": "X",
-        "ts": start_s * 1e6, "dur": (end_s - start_s) * 1e6,
-        "pid": pid, "tid": tid, "args": args or {},
-    })
+    with _events_lock:
+        _events.append({
+            "name": name, "cat": category, "ph": "X",
+            "ts": start_s * 1e6, "dur": (end_s - start_s) * 1e6,
+            "pid": pid, "tid": tid, "args": args or {},
+        })
 
 
 def timeline(filename: Optional[str] = None):
-    """Dump chrome://tracing events (reference: _private/state.py:828)."""
+    """Dump ONE merged chrome://tracing stream (reference:
+    _private/state.py:828 ``ray.timeline``): app-recorded spans
+    (:func:`record_span`), this process's tracer spans, and every span
+    shipped to the head by worker/daemon telemetry — each process on its
+    own real pid row, named via ``process_name`` metadata events."""
     import json
 
-    data = list(_events)
+    from . import telemetry
+    from .tracing import get_tracer
+
+    with _events_lock:
+        data = list(_events)
+    data.extend(get_tracer().chrome_trace_events())
+    data.extend(telemetry.remote_chrome_events())
+    data.extend(telemetry.chrome_process_metadata())
     if filename:
         with open(filename, "w") as f:
             json.dump(data, f)

@@ -1,0 +1,260 @@
+"""Cluster-wide telemetry plane: worker/daemon -> head metric + span shipping.
+
+Reference analog: ``_private/metrics_agent.py`` (per-node OpenCensus agent
+aggregating worker metrics) + ``dashboard/modules/reporter/reporter_agent.py``
+and the dashboard-head aggregation that makes cluster ``/metrics`` and
+``ray timeline`` see every process, not just the head.
+
+Two halves:
+
+- :class:`TelemetryExporter` lives in every NON-HEAD process (task/actor
+  workers, node daemons). Each flush it snapshots the process-local
+  metrics registry, computes DELTAS against the previous flush (counters
+  and histograms subtract; gauges ship current values when changed),
+  drains finished spans from the local tracer, and returns one compact
+  payload. Workers ship it over the existing worker pipe as a
+  ``("telemetry", payload)`` message; daemons over their control
+  connection. Flush period is ``metrics_report_interval_ms``; a final
+  flush runs at clean worker exit so short-lived workers aren't lost.
+
+- :func:`absorb` runs on the head: merges metric deltas into the head
+  registry with ``node``/``worker`` tags added, and files the shipped
+  spans (already chrome events, stamped with the origin pid) into a
+  bounded buffer that ``observability.state.timeline`` merges — one
+  Chrome trace with a real pid row per process.
+
+Everything is gated on the ``telemetry_enabled`` config flag (default
+on); ``RT_TELEMETRY_ENABLED=0`` turns the whole plane off for overhead
+A/B runs (see BASELINE.md "Telemetry overhead").
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    core_metrics,
+    get_or_create,
+    registry,
+)
+from .tracing import get_tracer, span_chrome_event
+
+# Spans shipped from remote processes, already in chrome-event form with
+# their origin pid. Bounded: a chatty cluster must not grow the head.
+_REMOTE_EVENTS_MAX = 50_000
+# Backstop for absorbed metric SERIES too: worker churn mints a fresh
+# worker-id tag per short-lived worker, and each absorbed (node, worker)
+# tag set is a permanent series in the head registry. Beyond this many
+# series per metric, absorb updates existing series but creates no new
+# ones (same philosophy as the bounded span buffers).
+_ABSORB_SERIES_MAX = 10_000
+_remote_events: deque = deque(maxlen=_REMOTE_EVENTS_MAX)
+# pid -> human name ("worker ab12cd34" / "daemon ef567890") for the
+# chrome trace process_name metadata rows. Bounded like the other
+# buffers: worker churn mints a fresh pid per short-lived worker.
+_PROC_NAMES_MAX = 4096
+_proc_names: Dict[int, str] = {}
+_absorb_lock = threading.Lock()
+
+
+class TelemetryExporter:
+    """Per-process delta snapshotter (worker / daemon side)."""
+
+    def __init__(self, node: Optional[str] = None,
+                 worker: Optional[str] = None,
+                 proc: Optional[str] = None):
+        self.node = node
+        self.worker = worker
+        self.proc = proc
+        self.pid = os.getpid()
+        self._last: Dict[str, tuple] = {}
+        # Serializes collect(): the worker's exit flush runs on the task
+        # loop thread while the periodic flusher thread may be mid-cycle;
+        # an unsynchronized read-modify-write of _last would ship the
+        # same delta twice and double-count on the head.
+        self._collect_lock = threading.Lock()
+        # Spans recorded from here on are kept for export too.
+        get_tracer().export_enabled = True
+
+    def collect(self) -> Optional[dict]:
+        """One flush: metric deltas + newly finished spans, or None when
+        nothing moved (so idle processes cost zero pipe traffic)."""
+        with self._collect_lock:
+            return self._collect_locked()
+
+    def _collect_locked(self) -> Optional[dict]:
+        metrics_out: List[tuple] = []
+        for name, (kind, data) in registry.collect_all().items():
+            _prev_kind, prev = self._last.get(name, (kind, {}))
+            deltas: Dict[tuple, Any] = {}
+            if kind == "gauge":
+                if data != prev:
+                    deltas = dict(data)
+            elif kind == "counter":
+                for key, val in data.items():
+                    d = val - prev.get(key, 0.0)
+                    if d:
+                        deltas[key] = d
+            else:  # histogram
+                for key, h in data.items():
+                    ph = prev.get(key)
+                    if ph is None:
+                        d = h
+                    else:
+                        d = {"buckets": [a - b for a, b in
+                                         zip(h["buckets"], ph["buckets"])],
+                             "sum": h["sum"] - ph["sum"],
+                             "count": h["count"] - ph["count"]}
+                    if d["count"]:
+                        deltas[key] = d
+            self._last[name] = (kind, data)
+            if deltas:
+                boundaries = None
+                if kind == "histogram":
+                    metric = registry.get(name)
+                    boundaries = (list(metric.boundaries)
+                                  if metric is not None else None)
+                metrics_out.append((name, kind, boundaries, deltas))
+        spans = [span_chrome_event(s, self.pid)
+                 for s in get_tracer().drain_export()
+                 if s.end_s is not None]
+        if not metrics_out and not spans:
+            return None
+        return {
+            "node": self.node, "worker": self.worker,
+            "pid": self.pid, "proc": self.proc,
+            "metrics": metrics_out, "spans": spans,
+        }
+
+
+def absorb(payload: dict) -> None:
+    """Head side: merge one telemetry payload into the head registry
+    and the remote-span buffer.
+
+    Counters and histograms are ADDITIVE: ``node``/``worker`` tags are
+    added so concurrent processes' deltas land in distinct series.
+    Gauges keep the PRODUCER's tags unchanged — a gauge's identity is
+    whatever tag set its owner chose (e.g. the serve controller's
+    ``rt_serve_replicas{deployment}``, the daemon's node-tagged store
+    gauge), so a restarted producer overwrites its old value instead of
+    leaving a stale per-worker series that consumers would double-sum."""
+    if not isinstance(payload, dict):
+        return
+    extra = {}
+    if payload.get("node"):
+        extra["node"] = payload["node"]
+    if payload.get("worker"):
+        extra["worker"] = payload["worker"]
+    with _absorb_lock:
+        for name, kind, boundaries, data in payload.get("metrics", ()):
+            # get_or_create: atomic vs the lazy factories (core/serve)
+            # racing to the same name from other threads.
+            if kind == "counter":
+                metric = get_or_create(Counter, name)
+            elif kind == "gauge":
+                metric = get_or_create(Gauge, name)
+            else:
+                metric = get_or_create(Histogram, name,
+                                       boundaries=boundaries or ())
+            capped = metric.series_count() >= _ABSORB_SERIES_MAX
+            for tags_key, value in data.items():
+                tags = dict(tags_key)
+                if kind != "gauge":
+                    tags.update(extra)
+                try:
+                    if capped and not metric.has_series(
+                            metric._tags_key(tags)):
+                        continue
+                    if kind == "counter" and isinstance(metric, Counter):
+                        metric.inc(value, tags=tags)
+                    elif kind == "gauge" and isinstance(metric, Gauge):
+                        metric.set(value, tags=tags)
+                    elif kind == "histogram" and isinstance(metric,
+                                                            Histogram):
+                        metric.merge_delta(value, tags=tags)
+                except Exception:  # noqa: BLE001 — one bad series max
+                    continue
+        pid = payload.get("pid")
+        if pid is not None:
+            if payload.get("proc"):
+                _proc_names[int(pid)] = payload["proc"]
+                while len(_proc_names) > _PROC_NAMES_MAX:
+                    _proc_names.pop(next(iter(_proc_names)))  # oldest
+            for event in payload.get("spans", ()):
+                _remote_events.append(event)
+
+
+def remote_chrome_events() -> List[dict]:
+    with _absorb_lock:
+        return list(_remote_events)
+
+
+def chrome_process_metadata() -> List[dict]:
+    """chrome://tracing ``process_name`` metadata rows: the driver plus
+    every remote process that has shipped telemetry."""
+    events = [{"name": "process_name", "ph": "M", "pid": os.getpid(),
+               "args": {"name": "driver"}}]
+    with _absorb_lock:
+        names = dict(_proc_names)
+    for pid, name in sorted(names.items()):
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": name}})
+    return events
+
+
+def clear() -> None:
+    """Drop absorbed remote state (test isolation)."""
+    with _absorb_lock:
+        _remote_events.clear()
+        _proc_names.clear()
+
+
+def refresh_cluster_gauges() -> None:
+    """Sample head-visible cluster gauges into ``core_metrics()``:
+    actors/workers alive from the GCS/scheduler tables and per-node
+    object-store bytes for in-process stores (daemon-backed nodes report
+    their own store through their exporter). Called on every ``/metrics``
+    scrape so the gauges can't go stale or bitrot."""
+    from ..core.config import config
+    from ..core.gcs import ActorState
+    from ..core.runtime import get_head_runtime
+
+    rt = get_head_runtime()
+    if rt is None or not config().telemetry_enabled:
+        return
+    m = core_metrics()
+    try:
+        alive = sum(1 for a in rt.gcs.list_actors()
+                    if a.state == ActorState.ALIVE)
+        m["actors_alive"].set(float(alive))
+    except Exception:  # noqa: BLE001 — scrape must never 500
+        pass
+    workers = 0
+    for node in rt.scheduler.nodes():
+        try:
+            workers += sum(1 for w in node.pool.all_workers() if w.alive())
+        except Exception:  # noqa: BLE001
+            continue
+        if getattr(node, "is_remote", False):
+            continue  # daemon reports its own store over its conn
+        try:
+            used = node.store.stats().get("used_bytes", 0)
+            m["object_store_bytes"].set(
+                float(used), tags={"node": node.node_id.hex()[:8]})
+        except Exception:  # noqa: BLE001
+            pass
+    m["workers_alive"].set(float(workers))
+    mem_stats = getattr(rt.memory_store, "stats", None)
+    if mem_stats is not None:
+        try:
+            m["object_store_bytes"].set(
+                float(mem_stats().get("used_bytes", 0)),
+                tags={"node": "driver-memory"})
+        except Exception:  # noqa: BLE001
+            pass

@@ -49,6 +49,12 @@ class Tracer:
         self.max_spans = max_spans
         self._spans: List[Span] = []
         self._lock = threading.Lock()
+        # Export plane (cluster telemetry): when a TelemetryExporter is
+        # attached it flips export_enabled and drains finished spans on
+        # each flush; bounded the same way so a stalled flusher can't
+        # grow the process.
+        self.export_enabled = False
+        self._export: List[Span] = []
 
     def enable(self) -> None:
         self.enabled = True
@@ -61,6 +67,17 @@ class Tracer:
             self._spans.append(span)
             if len(self._spans) > self.max_spans:
                 self._spans = self._spans[-self.max_spans:]
+            if self.export_enabled:
+                self._export.append(span)
+                if len(self._export) > self.max_spans:
+                    self._export = self._export[-self.max_spans:]
+
+    def drain_export(self) -> List[Span]:
+        """Finished spans recorded since the last drain (telemetry
+        flush path; worker/daemon processes ship these to the head)."""
+        with self._lock:
+            out, self._export = self._export, []
+        return out
 
     def spans(self, name_prefix: str = "") -> List[Span]:
         with self._lock:
@@ -69,25 +86,34 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._spans = []
+            self._export = []  # cleared means cleared: nothing ships
 
     def chrome_trace_events(self) -> List[dict]:
         """Spans as chrome://tracing 'X' (complete) events, mergeable
-        with ``observability.state.timeline`` output."""
+        with ``observability.state.timeline`` output. The pid is THIS
+        process's real pid so merged cluster timelines show one row per
+        process (driver / workers / daemons)."""
+        import os
+
         with self._lock:
             spans = list(self._spans)
-        events = []
-        for s in spans:
-            if s.end_s is None:
-                continue
-            events.append({
-                "name": s.name, "ph": "X", "cat": "span",
-                "ts": s.start_s * 1e6,
-                "dur": (s.end_s - s.start_s) * 1e6,
-                "pid": "spans", "tid": s.trace_id[:8],
-                "args": {**s.attributes, "span_id": s.span_id,
-                         "parent_id": s.parent_id},
-            })
-        return events
+        pid = os.getpid()
+        return [span_chrome_event(s, pid) for s in spans
+                if s.end_s is not None]
+
+
+def span_chrome_event(s: Span, pid) -> dict:
+    """One finished span as a chrome://tracing complete event; shared by
+    the local dump and the telemetry export path (which stamps the
+    ORIGIN process's pid before shipping)."""
+    return {
+        "name": s.name, "ph": "X", "cat": "span",
+        "ts": s.start_s * 1e6,
+        "dur": ((s.end_s or s.start_s) - s.start_s) * 1e6,
+        "pid": pid, "tid": s.trace_id[:8],
+        "args": {**s.attributes, "span_id": s.span_id,
+                 "parent_id": s.parent_id},
+    }
 
 
 _tracer = Tracer()

@@ -70,8 +70,26 @@ def cmd_timeline(args) -> int:
     from ray_tpu.observability import timeline
 
     rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
-    path = timeline(args.output)
-    print(f"timeline written to {path}")
+    events = timeline()  # merged: driver + worker/daemon shipped spans
+    with open(args.output, "w") as f:
+        json.dump(events, f)  # exactly the snapshot counted below
+    pids = {e.get("pid") for e in events if e.get("ph") == "X"}
+    print(f"timeline written to {args.output} "
+          f"({sum(1 for e in events if e.get('ph') == 'X')} events, "
+          f"{len(pids)} process rows)")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    """Cluster /metrics in Prometheus text form, straight from the head
+    registry (workers/daemons fold in via the telemetry plane)."""
+    import ray_tpu as rt
+    from ray_tpu.observability import refresh_cluster_gauges
+    from ray_tpu.observability.metrics import registry
+
+    rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
+    refresh_cluster_gauges()
+    sys.stdout.write(registry.prometheus_text())
     return 0
 
 
@@ -231,7 +249,9 @@ def build_parser() -> argparse.ArgumentParser:
     lp.add_argument("entity", choices=["nodes", "tasks", "actors", "objects",
                                        "workers", "placement-groups"])
     sub.add_parser("memory", help="object store usage")
-    tp = sub.add_parser("timeline", help="dump chrome://tracing json")
+    sub.add_parser("metrics", help="cluster metrics (Prometheus text)")
+    tp = sub.add_parser("timeline", help="dump merged chrome://tracing json "
+                                         "(driver + worker + daemon rows)")
     tp.add_argument("--output", default="/tmp/rt_timeline.json")
     mb = sub.add_parser("microbenchmark", help="core perf scenarios")
     mb.add_argument("--duration", type=float, default=2.0)
@@ -261,6 +281,7 @@ def main(argv=None) -> int:
         "status": cmd_status,
         "list": cmd_list,
         "memory": cmd_memory,
+        "metrics": cmd_metrics,
         "timeline": cmd_timeline,
         "microbenchmark": cmd_microbenchmark,
         "dashboard": cmd_dashboard,

@@ -19,12 +19,87 @@ model placement/sharding happens inside the replica via ``parallel``.
 from __future__ import annotations
 
 import math
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from ..core import get, kill, remote, wait
 from ..core.actor import ActorHandle
+
+# -- first-class Serve metrics (reference: serve/_private/metrics_utils +
+# the serve_* series of metric_defs.cc). Created lazily in whichever
+# process first serves traffic: replica processes observe request
+# counts/latency (shipped to the head by worker telemetry, which tags
+# node/worker), the controller process sets the replica-count gauge, and
+# driver-side routers set queue depth directly in the head registry.
+_serve_metrics_cache: Optional[Dict[str, Any]] = None
+_serve_metrics_lock = threading.Lock()
+
+
+def serve_metrics() -> Optional[Dict[str, Any]]:
+    """The serve metric family, or None with telemetry disabled."""
+    global _serve_metrics_cache
+
+    from ..core.config import config
+    from ..observability.metrics import (
+        Counter,
+        Gauge,
+        Histogram,
+        get_or_create,
+    )
+
+    if not config().telemetry_enabled:
+        return None
+    with _serve_metrics_lock:
+        if _serve_metrics_cache is None:
+            # get_or_create: the telemetry absorber may have minted
+            # these names first (controller/replica flushes land before
+            # the driver's first Router) — reconstructing would REPLACE
+            # the registered metric and drop the absorbed series.
+            _serve_metrics_cache = {
+                "requests": get_or_create(
+                    Counter, "rt_serve_requests",
+                    "Serve requests handled per deployment",
+                    ("deployment", "result")),
+                "latency": get_or_create(
+                    Histogram, "rt_serve_request_latency_seconds",
+                    "Replica-side request latency",
+                    boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                                1.0, 5.0],
+                    tag_keys=("deployment",)),
+                "queue_depth": get_or_create(
+                    Gauge, "rt_serve_queue_depth",
+                    "Router in-flight requests per deployment",
+                    ("deployment",)),
+                "replicas": get_or_create(
+                    Gauge, "rt_serve_replicas",
+                    "Live replicas per deployment", ("deployment",)),
+            }
+        return _serve_metrics_cache
+
+
+# Deployment-wide in-flight totals shared by EVERY driver-side router
+# of a deployment (the proxy and each handle own separate Routers): the
+# queue-depth gauge must report their sum, not whichever router wrote
+# last. One tiny process-wide lock; the heavy per-request coordination
+# stays on each router's own condvar.
+_qd_lock = threading.Lock()
+_qd_totals: Dict[str, int] = {}
+
+
+def _queue_depth_note(name: str, delta: int, gauge=None,
+                      key=None) -> int:
+    """Update the deployment total and (when given) mirror it into the
+    gauge UNDER the same lock — a set outside it can interleave with
+    another router's update and publish a stale value (e.g. nonzero at
+    idle). The metric lock is a leaf, so nesting it here is safe."""
+    with _qd_lock:
+        total = max(0, _qd_totals.get(name, 0) + delta)
+        _qd_totals[name] = total
+        if gauge is not None:
+            gauge.set_key(key, float(total))
+    return total
 
 
 @dataclass
@@ -68,7 +143,8 @@ class _Replica:
 
     def __init__(self, deployment_def, init_args, init_kwargs,
                  request_timeout_s: Optional[float] = None,
-                 user_config: Optional[dict] = None):
+                 user_config: Optional[dict] = None,
+                 deployment_name: str = ""):
         import inspect
 
         if inspect.isclass(deployment_def):
@@ -85,6 +161,42 @@ class _Replica:
         self._timeout = request_timeout_s
         self._streams: Dict[int, Any] = {}
         self._stream_counter = 0
+        # Request counter + latency histogram, deployment-tagged; the
+        # worker telemetry flusher ships them to the head registry. Tag
+        # keys interned once — this runs per request.
+        self._deployment = deployment_name
+        self._metrics = serve_metrics()
+        if self._metrics is not None:
+            self._key_ok = (("deployment", deployment_name),
+                            ("result", "ok"))
+            self._key_err = (("deployment", deployment_name),
+                             ("result", "error"))
+            self._key_lat = (("deployment", deployment_name),)
+
+    def _observe(self, start: float, n: int, ok: bool) -> None:
+        if self._metrics is None:
+            return
+        elapsed = time.perf_counter() - start
+        self._metrics["requests"].inc_key(
+            self._key_ok if ok else self._key_err, n)
+        self._metrics["latency"].observe_key(self._key_lat, elapsed,
+                                             count=n)
+
+    def _observe_batch(self, start: float, n: int, results) -> None:
+        """Coalesced-entry accounting: ``results`` is the final
+        ("ok"|"err", value) list, or None when the whole batch raised —
+        per-item errors must land in result="error", not "ok"."""
+        if self._metrics is None:
+            return
+        elapsed = time.perf_counter() - start
+        n_err = (sum(1 for tag, _ in results if tag == "err")
+                 if results is not None else n)
+        if n - n_err:
+            self._metrics["requests"].inc_key(self._key_ok, n - n_err)
+        if n_err:
+            self._metrics["requests"].inc_key(self._key_err, n_err)
+        self._metrics["latency"].observe_key(self._key_lat, elapsed,
+                                             count=n)
 
     @staticmethod
     def _resolve_target(fn):
@@ -155,12 +267,18 @@ class _Replica:
             self._sweep_streams()
         self._ongoing += 1
         self._total += 1
+        start = time.perf_counter()
+        ok = True
         try:
             fn = self.callable
             if not callable(fn):
                 raise TypeError("deployment is not callable")
             return await self._invoke(fn, args, kwargs)
+        except BaseException:
+            ok = False
+            raise
         finally:
+            self._observe(start, 1, ok)
             self._ongoing -= 1
 
     async def handle_request_batch(self, items):
@@ -184,6 +302,8 @@ class _Replica:
             self._sweep_streams()
         self._ongoing += len(items)
         self._total += len(items)
+        start = time.perf_counter()
+        out = None
         try:
             fn = self.callable
             if callable(fn) and inspect.iscoroutinefunction(
@@ -195,8 +315,9 @@ class _Replica:
                     except Exception as e:  # noqa: BLE001 — isolation
                         return ("err", repr(e))
 
-                return list(await asyncio.gather(
+                out = list(await asyncio.gather(
                     *(one(a, k) for a, k in items)))
+                return out
 
             def run_all():
                 out = []
@@ -227,17 +348,25 @@ class _Replica:
                     except Exception as e:  # noqa: BLE001 — isolation
                         tag, val = "err", repr(e)
                 final.append((tag, val))
-            return final
+            out = final
+            return out
         finally:
+            self._observe_batch(start, len(items), out)
             self._ongoing -= len(items)
 
     async def call_method(self, method, args, kwargs):
         self._ongoing += 1
         self._total += 1
+        start = time.perf_counter()
+        ok = True
         try:
             return await self._invoke(
                 getattr(self.callable, method), args, kwargs)
+        except BaseException:
+            ok = False
+            raise
         finally:
+            self._observe(start, 1, ok)
             self._ongoing -= 1
 
     async def next_chunks(self, stream_id: int, max_n: int = 8):
@@ -346,6 +475,9 @@ class ServeController:
             info = self.deployments.pop(name, None)
             victims = self.replicas.pop(name, [])
             self._bump_locked(name)
+        metrics = serve_metrics()
+        if metrics is not None:
+            metrics["replicas"].set(0.0, tags={"deployment": name})
         for r in victims:
             try:
                 kill(r)
@@ -503,7 +635,8 @@ class ServeController:
                 **opts,
             ).remote(info.deployment_def, info.init_args, info.init_kwargs,
                      request_timeout_s=info.request_timeout_s,
-                     user_config=info.user_config)
+                     user_config=info.user_config,
+                     deployment_name=name)
             current.append(actor)
         while len(current) > target:
             victim = current.pop()
@@ -512,6 +645,11 @@ class ServeController:
                 kill(victim)
             except Exception:
                 pass
+        metrics = serve_metrics()
+        if metrics is not None:
+            # Runs in the controller process; telemetry ships it head-ward.
+            metrics["replicas"].set(float(len(current)),
+                                    tags={"deployment": name})
         if changed:
             self._bump_locked(name)
         return len(current)
@@ -548,6 +686,18 @@ class Router:
         self._slack = 16  # see _pick_slot_locked sticky-with-slack
         # keyed by replica actor id (stable across replica-set updates)
         self._inflight: Dict[bytes, int] = {}
+        # Router-wide in-flight total -> rt_serve_queue_depth gauge.
+        # DRIVER routers only: gauges keep producer tags through absorb,
+        # so a nested replica-worker router shipping the same
+        # {deployment} key would clobber the driver's live value with
+        # its own (usually near-zero) count. The driver (proxy +
+        # handles) is the authoritative ingress queue.
+        from ..core.runtime import is_worker_process
+
+        self._nq = 0
+        self._metrics = None if is_worker_process() else serve_metrics()
+        if self._metrics is not None:
+            self._qd_key = (("deployment", deployment_name),)
         self._waiters = 0  # blocked assigners; gate for notify_all
         self._lock = threading.Lock()
         self._slot_free = threading.Condition(self._lock)
@@ -599,13 +749,37 @@ class Router:
 
     def stop(self):
         self._stop.set()
+        # Give back this router's outstanding queue-depth contribution:
+        # serve.shutdown() drops routers with requests still in flight,
+        # and their _release callbacks may never run — without this the
+        # deployment-wide total (_qd_totals) stays offset forever and a
+        # restarted serve instance inherits a phantom queue depth.
+        # Clearing _inflight makes any late _release a no-op (its clamp
+        # sees 0), so the residual can't be subtracted twice.
+        with self._slot_free:
+            residual, self._nq = self._nq, 0
+            self._inflight.clear()
+        if residual and self._metrics is not None:
+            _queue_depth_note(self._name, -residual,
+                              self._metrics["queue_depth"], self._qd_key)
 
     def stats(self) -> Dict[str, Any]:
         """Router-local routing state (for tests/diagnostics)."""
         with self._slot_free:
             return {"replicas": len(self._replicas),
                     "sticky_index": self._rr,
+                    "queue_depth": self._nq,
                     "inflight": dict(self._inflight)}
+
+    def _note_inflight(self, delta: int) -> None:
+        """Under self._slot_free: track this router's in-flight count
+        and mirror the DEPLOYMENT-WIDE total (summed across routers via
+        _queue_depth_note) into the gauge — interned key, so the added
+        hot-path cost is two uncontended dict stores."""
+        self._nq = max(0, self._nq + delta)
+        if self._metrics is not None:
+            _queue_depth_note(self._name, delta,
+                              self._metrics["queue_depth"], self._qd_key)
 
     def assign(self, method: Optional[str], args, kwargs):
         return self.assign_with_replica(method, args, kwargs)[0]
@@ -640,6 +814,7 @@ class Router:
             # Equivalent to the scan outcome: sload - best_load <= slack
             # holds for every possible best_load >= 0.
             self._inflight[skey] = sload + 1
+            self._note_inflight(1)
             return self._replicas[self._rr], skey
         best = best_key = best_load = None
         for idx in range(n):
@@ -666,9 +841,11 @@ class Router:
                 # was part of the 8-replica handle inversion). The
                 # anchor only migrates when it is at hard capacity.
                 self._inflight[best_key] = best_load + 1
+                self._note_inflight(1)
                 return self._replicas[best], best_key
         self._rr = best
         self._inflight[best_key] = best_load + 1
+        self._note_inflight(1)
         return self._replicas[best], best_key
 
     def _submit(self, replica, key, method, args, kwargs):
@@ -749,6 +926,7 @@ class Router:
             free = self._max_cq - self._inflight.get(key, 0)
             extra = min(len(items) - 1, max(free, 0))
             self._inflight[key] += extra
+            self._note_inflight(extra)
             n = 1 + extra
         try:
             ref = replica.handle_request_batch.remote(list(items[:n]))
@@ -784,7 +962,12 @@ class Router:
     def _release(self, key: bytes, n: int = 1) -> None:
         with self._slot_free:
             c = self._inflight.get(key, 0)
-            self._inflight[key] = max(0, c - n)
+            # Clamp ONCE and apply the same released amount to both the
+            # per-replica map and the router/deployment totals, so a
+            # spurious double-release can't make them diverge.
+            released = n if n < c else c
+            self._inflight[key] = c - released
+            self._note_inflight(-released)
             if self._waiters:
                 # Gate the wake: _release runs on EVERY request
                 # completion, and an unconditional notify_all was a

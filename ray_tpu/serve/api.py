@@ -390,6 +390,42 @@ def list_deployments() -> Dict[str, dict]:
     return get(_controller().list_deployments.remote(), timeout=30)
 
 
+def serve_status_snapshot() -> Dict[str, Any]:
+    """Read-only Serve status for the dashboard's ``/api/serve``
+    endpoint: deployment table (replicas/target/route/version) plus
+    driver-side router state (queue depth per deployment). Never starts
+    an instance; ``{"running": False}`` when Serve is down."""
+    controller = _state.get("controller")
+    if controller is None:
+        return {"running": False, "deployments": {}}
+    try:
+        deployments = get(controller.list_deployments.remote(), timeout=5)
+    except Exception as e:  # noqa: BLE001 — dashboard must not 500
+        return {"running": True, "error": str(e), "deployments": {}}
+    # Aggregate across routers: the proxy and each handle own SEPARATE
+    # Routers for the same deployment — queue depths sum, and a
+    # name-keyed overwrite would hide all but the last one's load.
+    routers: Dict[str, dict] = {}
+    for router in _state.get("routers", []):
+        try:
+            stats = router.stats()  # JSON-safe subset (the inflight
+            entry = routers.setdefault(  # map is keyed by bytes)
+                router._name,
+                {"replicas": 0, "queue_depth": 0, "routers": 0})
+            entry["replicas"] = max(entry["replicas"], stats["replicas"])
+            entry["queue_depth"] += stats["queue_depth"]
+            entry["routers"] += 1
+        except Exception:  # noqa: BLE001
+            continue
+    http_addr = _state.get("http_addr")
+    return {
+        "running": True,
+        "http": f"{http_addr[0]}:{http_addr[1]}" if http_addr else None,
+        "deployments": deployments,
+        "routers": routers,
+    }
+
+
 # -- HTTP proxy --------------------------------------------------------------
 
 class _AsyncHTTPProxy:

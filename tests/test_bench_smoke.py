@@ -53,3 +53,15 @@ def test_bench_smoke_runs_all_stages():
     assert mixed["stream_tokens_per_s"] > 0, mixed
     assert mixed["stream_first_chunk_p99_ms"] >= \
         mixed["stream_first_chunk_p50_ms"]
+
+    # Telemetry plane wired through the bench: the mid-bench /metrics
+    # scrape must see runtime counters AND worker/replica-shipped series
+    # (latency histograms travel worker -> head over the pipe).
+    assert "telemetry_scrape_error" not in result, result
+    scrape = result["telemetry_scrape"]
+    assert scrape["rt_tasks_submitted_total"] > 0, scrape
+    assert scrape["rt_tasks_finished_total"] > 0, scrape
+    assert scrape["rt_task_latency_seconds_count"] > 0, scrape
+    assert scrape["rt_workers_alive"] > 0, scrape
+    assert scrape["rt_serve_requests_total"] > 0, scrape
+    assert scrape["rt_serve_request_latency_count"] > 0, scrape

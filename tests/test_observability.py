@@ -31,6 +31,58 @@ def test_counter_gauge_histogram():
     assert "t_latency_bucket" in text
 
 
+def test_prometheus_exposition_strict():
+    """Validate /metrics output against a strict line-format parser:
+    sanitized metric/label names, escaped label values, numeric sample
+    values, and the open histogram bucket labeled le="+Inf" (a bare
+    ``inf`` is rejected by real prometheus scrapers)."""
+    import re
+
+    from ray_tpu.observability import Counter, Gauge, Histogram, registry
+
+    c = Counter("strict.test-counter", tag_keys=("route",))
+    c.inc(2, tags={"route": 'a"b\\c\nd'})  # needs escaping
+    g = Gauge("strict gauge")  # space must sanitize
+    g.set(1.5)
+    h = Histogram("strict_hist", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(50.0)  # lands in the +Inf bucket
+
+    text = registry.prometheus_text()
+    type_line = re.compile(
+        r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (?:counter|gauge|histogram)$")
+    sample_line = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+        r'(?:\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*"'
+        r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*")*\})?'
+        r' [+-]?(?:\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|\+Inf|-Inf|NaN)$')
+    for line in text.splitlines():
+        if not line:
+            continue
+        assert type_line.match(line) or sample_line.match(line), \
+            f"malformed exposition line: {line!r}"
+
+    # Sanitization applied consistently (name rule == label-name rule).
+    assert "strict_test_counter{" in text
+    assert "strict_gauge 1.5" in text
+    # Escaped label value round-trips on one line.
+    assert 'route="a\\"b\\\\c\\nd"' in text
+    # The open bucket is le="+Inf", equals the series count, and the
+    # cumulative counts are monotonic.
+    hist_lines = [ln for ln in text.splitlines()
+                  if ln.startswith("strict_hist")]
+    buckets = [float(ln.rsplit(" ", 1)[1]) for ln in hist_lines
+               if "_bucket{" in ln]
+    assert buckets == sorted(buckets) and buckets[-1] == 3
+    inf_line = next(ln for ln in hist_lines if 'le="+Inf"' in ln)
+    assert inf_line.rsplit(" ", 1)[1] == "3"
+    count_line = next(ln for ln in hist_lines if "_count" in ln)
+    assert count_line.rsplit(" ", 1)[1] == "3"
+    assert not any(re.search(r'le="inf"', ln, re.IGNORECASE)
+                   for ln in hist_lines)
+
+
 def test_state_api(rt_shared):
     import ray_tpu as rt
     from ray_tpu.observability import (

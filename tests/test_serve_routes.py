@@ -98,6 +98,8 @@ def _bare_router(n_replicas: int, max_cq: int = 100,
     r._rr = 0
     r._slack = slack
     r._inflight = {}
+    r._nq = 0
+    r._metrics = None  # pick-logic tests: no gauge wiring
     r._waiters = 0
     r._lock = threading.Lock()
     r._slot_free = threading.Condition(r._lock)
