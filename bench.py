@@ -188,7 +188,8 @@ def main():
                          ("serve_bench", "bench_serve"),
                          ("serve_mixed", "bench_serve_mixed"),
                          ("envelope", "bench_envelope"),
-                         ("ring_parity", "bench_ring_parity")):
+                         ("ring_parity", "bench_ring_parity"),
+                         ("head_failover", "bench_head_failover")):
         try:
             result[key] = _run_host_bench_subprocess(fn_name)
         except Exception as e:
@@ -1060,6 +1061,47 @@ def scrape_telemetry(port: int = 18269) -> dict:
     }
 
 
+def bench_head_failover(smoke: bool = False) -> dict:
+    """Head-failover chaos loop (ROADMAP item 1 'done' criterion): run
+    the driver/head on a durable WAL, SIGKILL it mid-actor-workload
+    every cycle, and measure how long the replacement head takes to
+    recover — WAL replay + named-actor re-resolution + ``max_restarts``
+    re-run + the queued call completing. Reports per-cycle recovery
+    latency p50/p99 (``recover_ms``: init-to-recovered-call;
+    ``total_ms``: process spawn to READY, imports included)."""
+    import shutil
+    import tempfile
+
+    from ray_tpu.cluster_utils import HeadKiller
+    from ray_tpu.core.gcs_socket import build_native
+
+    if not build_native():
+        return {"error": "native toolchain unavailable"}
+    fast = os.environ.get("BENCH_SMOKE_FAST") == "1"
+    # First cycle creates the chaos actor; every later one is a recovery.
+    cycles = 2 if fast else (3 if smoke else 6)
+    tmp = tempfile.mkdtemp(prefix="rt_headchaos_")
+    killer = HeadKiller(os.path.join(tmp, "gcs.wal"),
+                        kill_after_s=0.3 if smoke else 1.0)
+    try:
+        samples = killer.run(cycles)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    recoveries = [s for s in samples if not s.get("created")]
+    out = {
+        "cycles": cycles,
+        "kills": len(killer.killed),
+        "recoveries": len(recoveries),
+        "actors_restarted_total": int(sum(
+            s.get("restarted", 0) for s in recoveries)),
+    }
+    for key in ("recover_ms", "total_ms"):
+        pct = percentiles([s[key] for s in recoveries], unit=None)
+        out[f"{key}_p50"] = pct["p50"]
+        out[f"{key}_p99"] = pct["p99"]
+    return out
+
+
 def smoke() -> dict:
     """``bench.py --smoke``: tiny-N versions of the host-plane bench
     scenarios (seconds, not minutes) so the bench code paths — core
@@ -1092,6 +1134,12 @@ def smoke() -> dict:
         result["telemetry_scrape"] = scrape_telemetry()
     except Exception as e:  # noqa: BLE001
         result["telemetry_scrape_error"] = repr(e)[:300]
+    # Head-failover recovery stage: subprocess heads on their own WAL —
+    # independent of this process's runtime, so it runs last either way.
+    try:
+        result["head_failover"] = bench_head_failover(smoke=True)
+    except Exception as e:  # noqa: BLE001
+        result["head_failover_error"] = repr(e)[:300]
     try:
         import ray_tpu as rt
 

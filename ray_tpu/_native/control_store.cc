@@ -22,9 +22,11 @@
 //     u32 frame_len | u8 0xFE | channel | payload
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <signal.h>
+#include <sys/file.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -60,6 +62,13 @@ enum Op : uint8_t {
   OP_SUBSCRIBE = 21,
   OP_HEALTH_START = 30,
   OP_STATS = 31,
+  // Durable control-plane tables (reference: gcs_table_storage.h — one
+  // storage table per FSM: actors, jobs, placement groups). Values are
+  // opaque frontend-encoded records; SCAN returns a full table so a
+  // restarted head can reload every FSM in one round trip per table.
+  OP_TABLE_PUT = 40,
+  OP_TABLE_DEL = 41,
+  OP_TABLE_SCAN = 42,
   OP_SHUTDOWN = 99,
   OP_PUSH = 0xFE,
 };
@@ -248,11 +257,39 @@ class ControlStore {
     return out;
   }
 
+  // Control-plane tables (actor/job/PG records) --------------------------
+  void TablePut(const std::string& table, const std::string& key,
+                const std::string& val) {
+    std::lock_guard<std::mutex> lk(mu_);
+    tables_[table][key] = val;
+  }
+  bool TableDel(const std::string& table, const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = tables_.find(table);
+    return it != tables_.end() && it->second.erase(key) > 0;
+  }
+  std::vector<std::pair<std::string, std::string>> TableScan(
+      const std::string& table) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<std::pair<std::string, std::string>> out;
+    auto it = tables_.find(table);
+    if (it == tables_.end()) return out;
+    out.reserve(it->second.size());
+    for (const auto& [k, v] : it->second) out.emplace_back(k, v);
+    return out;
+  }
+
   // Pubsub ---------------------------------------------------------------
   void Subscribe(const std::string& channel,
                  std::shared_ptr<Connection> conn) {
     std::lock_guard<std::mutex> lk(mu_);
-    subs_[channel].push_back(conn);
+    auto& vec = subs_[channel];
+    // Dedup per connection: a client's resubscribe handshake can race
+    // its own concurrent subscribe() — double registration would push
+    // every message twice for the connection's lifetime.
+    for (const auto& c : vec)
+      if (c.get() == conn.get()) return;
+    vec.push_back(conn);
   }
   uint32_t Publish(const std::string& channel, const std::string& payload) {
     std::vector<std::shared_ptr<Connection>> targets;
@@ -345,6 +382,8 @@ class ControlStore {
   std::mutex mu_;
   std::unordered_map<std::string, std::unordered_map<std::string, std::string>>
       kv_;
+  // table name -> key -> opaque record (std::map: deterministic scans)
+  std::unordered_map<std::string, std::map<std::string, std::string>> tables_;
   std::map<std::string, NodeEntry> nodes_;
   std::unordered_map<std::string, std::vector<std::shared_ptr<Connection>>>
       subs_;
@@ -374,7 +413,7 @@ std::mutex g_persist_mu;
 
 bool IsDurableOp(uint8_t op) {
   return op == OP_KV_PUT || op == OP_KV_DEL || op == OP_NODE_REGISTER ||
-         op == OP_NODE_MARK_DEAD;
+         op == OP_NODE_MARK_DEAD || op == OP_TABLE_PUT || op == OP_TABLE_DEL;
 }
 
 // Caller must hold g_persist_mu (the durable-op apply lock): log order
@@ -388,49 +427,95 @@ void PersistFrameLocked(const std::vector<char>& frame) {
   std::fflush(g_persist);
 }
 
+// Parse one durable-mutation frame, applying it to `store` when non-null
+// (validate-only pass when null). Returns false when the frame is not a
+// complete durable mutation. Used both by WAL replay (apply) and by the
+// connection handler BEFORE persisting (validate) — a malformed frame
+// must never reach the log, because replay treats an unparseable record
+// as a torn tail and truncates everything after it.
+bool ParseDurableFrame(ControlStore* store, const std::vector<char>& frame) {
+  Reader r(frame);
+  uint8_t op;
+  if (!r.U8(&op)) return false;
+  switch (op) {
+    case OP_KV_PUT: {
+      std::string ns, key, val;
+      uint8_t overwrite;
+      if (!r.Bytes(&ns) || !r.Bytes(&key) || !r.Bytes(&val) ||
+          !r.U8(&overwrite))
+        return false;
+      if (store) store->KvPut(ns, key, val, overwrite != 0);
+      return true;
+    }
+    case OP_KV_DEL: {
+      std::string ns, key;
+      if (!r.Bytes(&ns) || !r.Bytes(&key)) return false;
+      if (store) store->KvDel(ns, key);
+      return true;
+    }
+    case OP_NODE_REGISTER: {
+      std::string id, info;
+      if (!r.Bytes(&id) || !r.Bytes(&info)) return false;
+      if (store) store->NodeRegister(id, info);
+      return true;
+    }
+    case OP_NODE_MARK_DEAD: {
+      std::string id;
+      if (!r.Bytes(&id)) return false;
+      if (store) store->NodeMarkDead(id);
+      return true;
+    }
+    case OP_TABLE_PUT: {
+      std::string table, key, val;
+      if (!r.Bytes(&table) || !r.Bytes(&key) || !r.Bytes(&val)) return false;
+      if (store) store->TablePut(table, key, val);
+      return true;
+    }
+    case OP_TABLE_DEL: {
+      std::string table, key;
+      if (!r.Bytes(&table) || !r.Bytes(&key)) return false;
+      if (store) store->TableDel(table, key);
+      return true;
+    }
+    default:
+      // Only durable ops are ever logged; anything else is garbage bytes
+      // that happened to parse as a length-prefixed frame.
+      return false;
+  }
+}
+
 void ReplayLog(ControlStore* store, const char* path) {
   std::FILE* f = std::fopen(path, "rb");
   if (f == nullptr) return;  // first start: nothing to replay
   size_t replayed = 0;
+  // Byte offset just past the last fully-valid record: a SIGKILL
+  // mid-append leaves a truncated/garbage final record, which must be
+  // DROPPED (truncate below) — appending new mutations after the torn
+  // bytes would hide them from every future replay.
+  long valid_end = 0;
   for (;;) {
     uint32_t len;
-    if (std::fread(&len, 4, 1, f) != 1) break;
-    if (len > (64u << 20)) break;  // corrupt tail
+    if (std::fread(&len, 4, 1, f) != 1) break;          // clean EOF or torn len
+    if (len > (64u << 20)) break;                       // corrupt length
     std::vector<char> frame(len);
-    if (std::fread(frame.data(), 1, len, f) != len) break;  // torn write
-    Reader r(frame);
-    uint8_t op;
-    if (!r.U8(&op)) break;
-    switch (op) {
-      case OP_KV_PUT: {
-        std::string ns, key, val;
-        uint8_t overwrite;
-        if (r.Bytes(&ns) && r.Bytes(&key) && r.Bytes(&val) &&
-            r.U8(&overwrite))
-          store->KvPut(ns, key, val, overwrite != 0);
-        break;
-      }
-      case OP_KV_DEL: {
-        std::string ns, key;
-        if (r.Bytes(&ns) && r.Bytes(&key)) store->KvDel(ns, key);
-        break;
-      }
-      case OP_NODE_REGISTER: {
-        std::string id, info;
-        if (r.Bytes(&id) && r.Bytes(&info)) store->NodeRegister(id, info);
-        break;
-      }
-      case OP_NODE_MARK_DEAD: {
-        std::string id;
-        if (r.Bytes(&id)) store->NodeMarkDead(id);
-        break;
-      }
-      default:
-        break;
-    }
+    if (std::fread(frame.data(), 1, len, f) != len) break;  // torn body
+    if (!ParseDurableFrame(store, frame)) break;        // garbage record
     replayed++;
+    valid_end = std::ftell(f);
   }
+  std::fseek(f, 0, SEEK_END);
+  long file_end = std::ftell(f);
   std::fclose(f);
+  if (file_end > valid_end) {
+    if (::truncate(path, valid_end) == 0) {
+      std::fprintf(stderr,
+                   "control_store: dropped torn log tail (%ld bytes at "
+                   "offset %ld) in %s\n",
+                   file_end - valid_end, valid_end, path);
+    } else {
+      std::perror("control_store: truncate torn tail");
+    }
+  }
   std::fprintf(stderr, "control_store: replayed %zu mutations from %s\n",
                replayed, path);
 }
@@ -448,9 +533,12 @@ void HandleConnection(ControlStore* store, std::shared_ptr<Connection> conn) {
     // Durable ops serialize log+apply under one lock so the mutation log
     // replays in exactly the order mutations took effect; the log write
     // happens BEFORE the case sends its ack (write-ahead: an acked
-    // mutation is never lost to a crash between ack and append).
+    // mutation is never lost to a crash between ack and append) but only
+    // AFTER the body validates — a malformed frame in the log would read
+    // as a torn tail on replay and truncate every record after it.
     std::unique_lock<std::mutex> durable_lk;
     if (IsDurableOp(op)) {
+      if (!ParseDurableFrame(nullptr, frame)) goto malformed;
       durable_lk = std::unique_lock<std::mutex>(g_persist_mu);
       PersistFrameLocked(frame);
     }
@@ -557,6 +645,36 @@ void HandleConnection(ControlStore* store, std::shared_ptr<Connection> conn) {
         w.Send(conn.get());
         break;
       }
+      case OP_TABLE_PUT: {
+        std::string table, key, val;
+        if (!r.Bytes(&table) || !r.Bytes(&key) || !r.Bytes(&val))
+          goto malformed;
+        store->TablePut(table, key, val);
+        Writer w(ST_OK);
+        w.Send(conn.get());
+        break;
+      }
+      case OP_TABLE_DEL: {
+        std::string table, key;
+        if (!r.Bytes(&table) || !r.Bytes(&key)) goto malformed;
+        Writer w(ST_OK);
+        w.U8(store->TableDel(table, key) ? 1 : 0);
+        w.Send(conn.get());
+        break;
+      }
+      case OP_TABLE_SCAN: {
+        std::string table;
+        if (!r.Bytes(&table)) goto malformed;
+        auto entries = store->TableScan(table);
+        Writer w(ST_OK);
+        w.U32(static_cast<uint32_t>(entries.size()));
+        for (const auto& [k, v] : entries) {
+          w.Bytes(k);
+          w.Bytes(v);
+        }
+        w.Send(conn.get());
+        break;
+      }
       case OP_HEALTH_START: {
         double period;
         uint32_t beats;
@@ -614,12 +732,33 @@ int main(int argc, char** argv) {
   int port = 0;  // 0 = ephemeral; actual port printed to stdout
   const char* host = "127.0.0.1";
   const char* persist = nullptr;
-  for (int i = 1; i < argc - 1; i++) {
+  bool die_with_parent = false;
+  for (int i = 1; i < argc; i++) {
+    if (!std::strcmp(argv[i], "--die-with-parent")) die_with_parent = true;
+    if (i >= argc - 1) continue;
     if (!std::strcmp(argv[i], "--port")) port = std::atoi(argv[i + 1]);
     if (!std::strcmp(argv[i], "--host")) host = argv[i + 1];
     if (!std::strcmp(argv[i], "--persist")) persist = argv[i + 1];
   }
   ::signal(SIGPIPE, SIG_IGN);
+  if (die_with_parent) {
+    // Die with the spawning head process (head-failover chaos: a
+    // SIGKILLed head must not leave an orphan daemon appending to the
+    // WAL that the replacement head is about to replay and reopen).
+    // A ppid poll, NOT PR_SET_PDEATHSIG: the prctl signal fires when
+    // the spawning THREAD exits, which would falsely kill the daemon
+    // under a head that called init() from a short-lived thread.
+    // Exit on ppid CHANGE, not on ppid==1 — the head may legitimately
+    // BE pid 1 (container entrypoint), and its death then tears the
+    // whole pid namespace down anyway.
+    pid_t parent = ::getppid();
+    std::thread([parent] {
+      for (;;) {
+        ::usleep(500 * 1000);
+        if (::getppid() != parent) ::_exit(0);  // reparented: head died
+      }
+    }).detach();
+  }
 
   int listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (listen_fd < 0) {
@@ -647,6 +786,29 @@ int main(int argc, char** argv) {
 
   ControlStore store;
   if (persist != nullptr) {
+    // Single-writer guard BEFORE replay: a lingering predecessor daemon
+    // still appending would corrupt the log under us (and our replay
+    // would miss its in-flight mutations). Bounded wait, then fail
+    // loudly before the port handshake.
+    int lock_fd = ::open(persist, O_RDWR | O_CREAT, 0644);
+    if (lock_fd < 0) {
+      std::perror("persist open");
+      return 1;
+    }
+    bool locked = false;
+    for (int i = 0; i < 100; i++) {  // ~5s
+      if (::flock(lock_fd, LOCK_EX | LOCK_NB) == 0) {
+        locked = true;
+        break;
+      }
+      ::usleep(50 * 1000);
+    }
+    if (!locked) {
+      std::fprintf(stderr,
+                   "control_store: %s is locked by another daemon\n",
+                   persist);
+      return 1;
+    }
     ReplayLog(&store, persist);
     g_persist = std::fopen(persist, "ab");
     if (g_persist == nullptr) {
@@ -655,6 +817,7 @@ int main(int argc, char** argv) {
       std::perror("persist open");
       return 1;
     }
+    // lock_fd stays open (and locked) for the daemon's lifetime.
   }
   // Startup handshake: the launcher reads the bound port from stdout.
   std::printf("CONTROL_STORE_PORT %d\n", ntohs(addr.sin_port));

@@ -148,3 +148,156 @@ class NodeKiller:
         if self._thread is not None:
             self._thread.join(timeout=2)
             self._thread = None
+
+
+# Driver script run by each HeadKiller head process. Cycle 1 creates the
+# named chaos actor; every later cycle is a RECOVERY: the replacement
+# head replays the WAL during init, the actor re-resolves by name, and
+# the first call (queued while the actor restarts) completes. Prints one
+# parseable READY line, then keeps the actor-call workload running until
+# the killer SIGKILLs the process mid-workload.
+_HEADKILLER_DRIVER_SRC = r"""
+import time
+_t0 = time.perf_counter()
+import ray_tpu as rt
+from ray_tpu.core import runtime as _rtm
+
+rt.init(num_cpus=2)
+_init_ms = (time.perf_counter() - _t0) * 1000.0
+
+
+@rt.remote
+class _ChaosCounter:
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+        return self.n
+
+
+_t1 = time.perf_counter()
+try:
+    h = rt.get_actor("chaos_counter")
+    created = 0
+except ValueError:
+    h = _ChaosCounter.options(name="chaos_counter",
+                              max_restarts=100000).remote()
+    created = 1
+v = rt.get(h.bump.remote(), timeout=120)
+_recover_ms = (time.perf_counter() - _t1) * 1000.0
+_rep = getattr(_rtm.get_head_runtime(), "recovery_report", None) or {}
+print("HEADKILLER_READY value=%d created=%d init_ms=%.1f "
+      "recover_ms=%.1f restarted=%d actor=%s"
+      % (v, created, _init_ms, _recover_ms,
+         _rep.get("actors_restarted", 0), h._actor_id.hex()), flush=True)
+while True:
+    rt.get(h.bump.remote())
+    time.sleep(0.005)
+"""
+
+
+class HeadKiller:
+    """Chaos fault injector for the HEAD: the NodeKiller counterpart for
+    the control plane's single point of failure.
+
+    Each cycle runs a driver/head process (with the native control store
+    on a shared WAL ``persist_path``), waits until it reports READY, lets
+    the actor-call workload run, then SIGKILLs the head mid-workload —
+    no teardown, exactly like a head-host crash. The next cycle's head
+    replays the WAL, re-resolves the named actor, restarts it
+    (``max_restarts``), and completes the queued call; the time that
+    takes is the recovery sample (reference:
+    ``release/nightly_tests/chaos_test`` + GCS FT restart drills).
+    """
+
+    READY_PREFIX = "HEADKILLER_READY"
+
+    def __init__(self, persist_path: str, kill_after_s: float = 0.5,
+                 spawn_timeout_s: float = 180.0,
+                 env: Optional[Dict[str, str]] = None,
+                 head_src: str = _HEADKILLER_DRIVER_SRC):
+        self.persist_path = persist_path
+        self.kill_after_s = kill_after_s
+        self.spawn_timeout_s = spawn_timeout_s
+        self.killed: list = []
+        self._env = dict(env or {})
+        self._head_src = head_src
+
+    def _child_env(self) -> Dict[str, str]:
+        import os
+
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env.update({
+            "RT_NATIVE_CONTROL_STORE": "1",
+            "RT_CONTROL_STORE_PERSIST_PATH": self.persist_path,
+            "JAX_PLATFORMS": "cpu",
+            "RT_JAX_PLATFORM": "cpu",
+            # Small arena: SIGKILLed heads leak their /dev/shm files
+            # until reboot; keep the per-cycle footprint tiny.
+            "RT_OBJECT_STORE_MEMORY": str(64 * 1024 * 1024),
+            "PYTHONUNBUFFERED": "1",
+            "PYTHONPATH": repo_root + os.pathsep + env.get(
+                "PYTHONPATH", ""),
+        })
+        env.update(self._env)
+        return env
+
+    def run_cycle(self, kill: bool = True) -> Dict[str, float]:
+        """One head lifetime: spawn → READY → (workload) → SIGKILL.
+
+        Returns the parsed READY fields plus ``total_ms`` (process spawn
+        to READY — the full restart-to-recovered wall time, imports and
+        WAL replay included).
+        """
+        import signal
+        import subprocess
+        import sys
+        import threading
+        import time
+
+        t_spawn = time.monotonic()
+        proc = subprocess.Popen(
+            [sys.executable, "-c", self._head_src],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=self._child_env(),
+        )
+        watchdog = threading.Timer(self.spawn_timeout_s, proc.kill)
+        watchdog.daemon = True
+        watchdog.start()
+        info: Optional[Dict[str, float]] = None
+        try:
+            for line in proc.stdout:
+                if line.startswith(self.READY_PREFIX):
+                    info = {}
+                    for kv in line.split()[1:]:
+                        k, _, v = kv.partition("=")
+                        try:
+                            info[k] = float(v)
+                        except ValueError:
+                            info[k] = v  # type: ignore[assignment]
+                    break
+        finally:
+            watchdog.cancel()
+        if info is None:
+            proc.kill()
+            proc.wait()
+            proc.stdout.close()
+            raise RuntimeError(
+                "head process exited before READY (rc=%s)"
+                % proc.returncode)
+        info["total_ms"] = (time.monotonic() - t_spawn) * 1000.0
+        if kill:
+            time.sleep(self.kill_after_s)  # let the workload run
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+            self.killed.append(proc.pid)
+        proc.stdout.close()
+        return info
+
+    def run(self, cycles: int) -> list:
+        """``cycles`` head lifetimes on one WAL; every cycle after the
+        first is a recovery (``created == 0``)."""
+        return [self.run_cycle() for _ in range(cycles)]

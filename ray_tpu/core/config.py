@@ -90,6 +90,25 @@ _define("native_control_store", bool, False,
         "Back the control store's KV/pubsub/node-liveness with the native "
         "C++ daemon (ray_tpu/_native/control_store.cc) instead of the "
         "in-process Python tables (reference: external gcs_server process).")
+_define("gcs_client_retry_attempts", int, 5,
+        "Transport-level attempts per control-store call: on a dropped "
+        "connection the client re-dials with exponential backoff instead "
+        "of failing the first call after a store restart "
+        "(reference: gcs_rpc_client.h retry/backoff).")
+_define("gcs_client_retry_base_ms", int, 50,
+        "Base delay of the control-store client reconnect backoff "
+        "(doubles per attempt, capped at 1s).")
+_define("daemon_rejoin_attempts", int, 0,
+        "After losing the driver connection, a node daemon re-dials the "
+        "cluster address this many times (exponential backoff) and "
+        "re-registers as a fresh node instead of exiting — head-failover "
+        "survivors rejoin the replacement head. Requires the head to "
+        "listen on a FIXED cluster_listener_port. 0 = exit on driver "
+        "death (default).")
+_define("cluster_listener_port", int, 0,
+        "Fixed port for the head's cluster (daemon-attach) listener; 0 "
+        "picks an ephemeral port. Set it when daemons must survive a "
+        "head restart and rejoin the replacement head.")
 
 # --- Workers -----------------------------------------------------------------
 _define("num_workers_per_node", int, 0,

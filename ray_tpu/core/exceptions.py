@@ -48,9 +48,26 @@ class ActorError(RuntimeError_):
     Reference: RayActorError.
     """
 
-    def __init__(self, actor_id=None, msg: str = "The actor died unexpectedly."):
+    def __init__(self, actor_id=None,
+                 msg: str = "The actor died unexpectedly.",
+                 death_cause: Optional[str] = None):
         self.actor_id = actor_id
+        # Why the actor is dead (reference: ActorDeathCause proto carried
+        # on RayActorError) — surfaced to every pending caller so a
+        # max_restarts exhaustion reads differently from a kill().
+        self.death_cause = death_cause
+        self._raw_msg = msg
+        if death_cause:
+            msg = f"{msg} (death cause: {death_cause})"
         super().__init__(msg)
+
+    def __reduce__(self):
+        # Default BaseException pickling re-calls cls(*args) with the
+        # FORMATTED message as the first positional (actor_id) — a
+        # worker-side caller would see a mangled error. Rebuild from the
+        # real fields instead.
+        return (type(self), (self.actor_id, self._raw_msg,
+                             self.death_cause))
 
 
 class ActorDiedError(ActorError):

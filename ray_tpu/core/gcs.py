@@ -12,6 +12,7 @@ mirroring how Ray's ``GcsClient`` wraps gRPC accessors
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import defaultdict
@@ -19,6 +20,29 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set
 
 from .ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
+
+logger = logging.getLogger(__name__)
+
+
+def _count_error(metric: str, **tags) -> None:
+    """Best-effort telemetry counter bump (never raises into callers)."""
+    try:
+        from ..observability.metrics import Counter, get_or_create
+
+        get_or_create(Counter, metric,
+                      "Control-plane error counter",
+                      tuple(tags)).inc(tags=tags or None)
+    except Exception:
+        pass
+
+
+def _note_callback_error(channel: str) -> None:
+    """A pubsub subscriber callback raised. Silently swallowing these
+    (the old behavior) hid real bugs in state-transition handlers; log
+    at warning and count so dashboards/tests can see the rate."""
+    logger.warning("pubsub subscriber callback failed on channel %r",
+                   channel, exc_info=True)
+    _count_error("rt_pubsub_callback_errors", channel=channel)
 
 
 @dataclass
@@ -51,6 +75,10 @@ class ActorInfo:
     max_restarts: int = 0
     death_cause: Optional[str] = None
     namespace: str = "default"
+    # Serialized creation TaskSpec — persisted with the record so a
+    # replacement head can re-run the creation (ReconstructActor path).
+    # None when the backing store has no durable tables.
+    creation_spec_blob: Optional[bytes] = None
 
 
 @dataclass
@@ -95,7 +123,7 @@ class Pubsub:
             try:
                 cb(message)
             except Exception:
-                pass
+                _note_callback_error(channel)
 
 
 class GlobalControlStore:
@@ -151,6 +179,64 @@ class GlobalControlStore:
                                                name="gcs-health")
         self._health_thread.start()
 
+    # -- durable table hooks (reference: gcs_table_storage.h) ---------------
+    # The base store keeps every FSM table in process memory only; the
+    # native-backed subclass overrides these two primitives to write
+    # through to the daemon's WAL-persisted tables. Each actor/job/PG
+    # mutation below funnels through them, so durability is a backend
+    # property, not something each call site opts into.
+    supports_persistent_tables = False
+
+    def _table_write(self, table: str, key: bytes, value: bytes) -> None:
+        pass
+
+    def _table_delete(self, table: str, key: bytes) -> None:
+        pass
+
+    def _persist_actor(self, info: ActorInfo) -> None:
+        """Persist an actor-state record. Called with ``self._lock``
+        HELD by every mutator: per-actor WAL record order must equal
+        apply order, or a failover replays the stale state (e.g. an
+        ALIVE record overtaking the DEAD that followed it). The bulky
+        creation spec is stored ONCE (``_persist_actor_spec``), not on
+        every state transition."""
+        if not self.supports_persistent_tables:
+            return  # skip the pickle entirely on the in-memory backend
+        import copy
+        import pickle
+
+        rec = copy.copy(info)
+        rec.creation_spec_blob = None
+        self._table_write("actors", info.actor_id.binary(),
+                          pickle.dumps(rec))
+
+    def _persist_actor_spec(self, info: ActorInfo) -> None:
+        if not self.supports_persistent_tables:
+            return
+        if info.creation_spec_blob is not None:
+            self._table_write("actor_specs", info.actor_id.binary(),
+                              info.creation_spec_blob)
+
+    def _persist_job(self, info: JobInfo) -> None:
+        if not self.supports_persistent_tables:
+            return
+        import pickle
+
+        self._table_write("jobs", info.job_id.binary(), pickle.dumps(info))
+
+    def persist_placement_group(self, desc: Dict[str, Any]) -> None:
+        """Write-through of a PG descriptor (plain dict with an ``id``
+        bytes key — the live PlacementGroup object holds unpicklable
+        scheduling state)."""
+        if not self.supports_persistent_tables:
+            return
+        import pickle
+
+        self._table_write("pgs", desc["id"], pickle.dumps(desc))
+
+    def delete_placement_group(self, pg_id_bin: bytes) -> None:
+        self._table_delete("pgs", pg_id_bin)
+
     # -- actor table (GcsActorManager) ---------------------------------------
     def register_actor(self, info: ActorInfo) -> None:
         with self._lock:
@@ -160,6 +246,8 @@ class GlobalControlStore:
                 if key in self.named_actors:
                     raise ValueError(f"Actor name {info.name!r} already taken")
                 self.named_actors[key] = info.actor_id
+            self._persist_actor_spec(info)
+            self._persist_actor(info)
 
     def update_actor(self, actor_id: ActorID, state: str,
                      node_id: Optional[NodeID] = None,
@@ -180,6 +268,10 @@ class GlobalControlStore:
                 info.num_restarts += 1
             if state == ActorState.DEAD and info.name:
                 self.named_actors.pop((info.namespace, info.name), None)
+            self._persist_actor(info)
+            if state == ActorState.DEAD:
+                # Terminal: the creation spec can never be replayed again.
+                self._table_delete("actor_specs", actor_id.binary())
         self.pubsub.publish("ACTOR", (state, actor_id))
 
     def get_actor(self, actor_id: ActorID) -> Optional[ActorInfo]:
@@ -199,6 +291,7 @@ class GlobalControlStore:
     def add_job(self, info: JobInfo) -> None:
         with self._lock:
             self.jobs[info.job_id] = info
+            self._persist_job(info)
 
     def finish_job(self, job_id: JobID, status: str = "SUCCEEDED") -> None:
         with self._lock:
@@ -206,6 +299,7 @@ class GlobalControlStore:
             if job:
                 job.status = status
                 job.end_time = time.time()
+                self._persist_job(job)
 
     # -- internal KV (GcsKVManager / StoreClientKV) --------------------------
     def kv_put(self, key: bytes, value: bytes, namespace: str = "default",
@@ -274,7 +368,7 @@ class _NativePubsub(Pubsub):
             try:
                 cb(message)
             except Exception:
-                pass
+                _note_callback_error(channel)
 
     def publish(self, channel: str, message: Any) -> None:
         import pickle
@@ -311,10 +405,96 @@ class NativeBackedControlStore(GlobalControlStore):
         self._client = self._proc.client()
         self.pubsub = _NativePubsub(self._client)
         self._sync_thread: Optional[threading.Thread] = None
+        # Durable FSM tables only make sense with a WAL behind them: an
+        # in-memory daemon dies with the head anyway.
+        self.supports_persistent_tables = bool(
+            config().control_store_persist_path)
 
     @property
     def native_address(self):
         return self._proc.address
+
+    # -- durable tables: write-through to the daemon's WAL ------------------
+    def _table_write(self, table: str, key: bytes, value: bytes) -> None:
+        if not self.supports_persistent_tables:
+            return
+        try:
+            # Single attempt: mutators call this holding the GCS lock,
+            # and the client's reconnect backoff would stall every
+            # control-plane operation behind a store blip.
+            self._client.table_put(table, key, value, retryable=False)
+        except Exception:
+            # A lost write degrades durability, never the live FSM (the
+            # in-memory tables stay correct); log + count so it is
+            # visible instead of silent.
+            logger.warning("control-store table write failed "
+                           "(table=%s)", table, exc_info=True)
+            _count_error("rt_control_store_write_errors", table=table)
+
+    def _table_delete(self, table: str, key: bytes) -> None:
+        if not self.supports_persistent_tables:
+            return
+        try:
+            self._client.table_del(table, key, retryable=False)
+        except Exception:
+            logger.warning("control-store table delete failed "
+                           "(table=%s)", table, exc_info=True)
+            _count_error("rt_control_store_write_errors", table=table)
+
+    def restore_tables(self) -> Dict[str, list]:
+        """Reload the persisted actor/job/PG tables (WAL replay output)
+        into the in-memory maps and return them for reconciliation.
+
+        Reference: GcsActorManager::Initialize / GcsJobManager restart
+        path — tables load from storage, then the manager reconciles
+        live state. Named-actor entries are rebuilt from non-DEAD actor
+        records (the name table is derived state, never stored twice).
+
+        Retention: DEAD actor records are kept (death_cause stays
+        queryable after a failover; only the creation spec is deleted),
+        so the table and the append-only WAL grow with lifetime-total
+        actors — WAL compaction / tombstone retention caps are a known
+        follow-up (reference: maximum_gcs_destroyed_actor_cached_count).
+        """
+        import pickle
+
+        out: Dict[str, list] = {"actors": [], "jobs": [], "pgs": []}
+        if not self.supports_persistent_tables:
+            return out
+        specs = dict(self._client.table_scan("actor_specs"))
+        for key, blob in self._client.table_scan("actors"):
+            try:
+                info = pickle.loads(blob)
+            except Exception:
+                logger.warning("dropping unreadable persisted actor "
+                               "record %r", key, exc_info=True)
+                continue
+            # State records are spec-free (written per transition); the
+            # spec was stored once at registration — rejoin them.
+            info.creation_spec_blob = specs.get(key)
+            with self._lock:
+                self.actors[info.actor_id] = info
+                if info.name and info.state != ActorState.DEAD:
+                    self.named_actors[(info.namespace, info.name)] = \
+                        info.actor_id
+            out["actors"].append(info)
+        for key, blob in self._client.table_scan("jobs"):
+            try:
+                job = pickle.loads(blob)
+            except Exception:
+                logger.warning("dropping unreadable persisted job "
+                               "record %r", key, exc_info=True)
+                continue
+            with self._lock:
+                self.jobs.setdefault(job.job_id, job)
+            out["jobs"].append(job)
+        for key, blob in self._client.table_scan("pgs"):
+            try:
+                out["pgs"].append(pickle.loads(blob))
+            except Exception:
+                logger.warning("dropping unreadable persisted placement-"
+                               "group record %r", key, exc_info=True)
+        return out
 
     # -- KV: daemon is the single source of truth -------------------------
     def kv_put(self, key: bytes, value: bytes, namespace: str = "default",

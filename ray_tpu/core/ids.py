@@ -79,7 +79,11 @@ class BaseID:
 
 class JobID(BaseID):
     SIZE = _JOB_ID_SIZE
-    _counter = [0]
+    # Random per-process base (not 0): driver job ids must differ across
+    # head incarnations, or a replacement head replaying the durable job
+    # table would mistake the dead head's RUNNING job for its own
+    # (head-failover reconciliation compares job ids).
+    _counter = [int.from_bytes(os.urandom(3), "little")]
     _lock = threading.Lock()
 
     @classmethod

@@ -50,8 +50,25 @@ class NodeDaemon:
                  object_store_memory: Optional[int] = None,
                  env: Optional[dict] = None,
                  num_workers: int = 0,
-                 resources: Optional[dict] = None):
+                 resources: Optional[dict] = None,
+                 rejoin_attempts: int = 0,
+                 rejoin_resources: Optional[dict] = None):
         self.node_id = node_id
+        # Head-failover survival: with rejoin_attempts > 0, a dropped
+        # driver connection triggers bounded re-dials of the SAME
+        # cluster address (the replacement head listens on the fixed
+        # cluster_listener_port) followed by re-registration via the
+        # adopt path, instead of daemon exit. rejoin_resources carries
+        # the node's REAL resource shape for head-spawned daemons
+        # (which otherwise register resources driver-side only).
+        self._driver_addr = driver_addr
+        self._env = dict(env or {})
+        self._num_workers = max(1, num_workers)
+        self._rejoin_attempts = rejoin_attempts
+        self._rejoin_resources = dict(
+            rejoin_resources if rejoin_resources is not None
+            else resources if resources is not None
+            else {"CPU": float(max(1, num_workers))})
         # Self-registration payload: set when this daemon was started from
         # a shell (``rt start --address=...``) rather than spawned by a
         # driver — the head ADOPTS it on registration (reference:
@@ -164,12 +181,86 @@ class NodeDaemon:
                              name="rt-daemon-telemetry").start()
         try:
             while not self._stopped.is_set():
-                msg = self.conn.recv()
+                try:
+                    msg = self.conn.recv()
+                except (EOFError, OSError):
+                    # Driver gone — clean FIN reads as EOFError, but a
+                    # SIGKILLed head with frames in flight commonly
+                    # surfaces as ECONNRESET (OSError). Default: exit (a
+                    # dead head takes its nodes down). With rejoin
+                    # enabled: survive the failover and re-register with
+                    # the replacement head.
+                    if self._rejoin_attempts <= 0 or not self._rejoin():
+                        break
+                    continue
                 self._handle(msg)
-        except EOFError:
-            pass  # driver gone: fall through to teardown
         finally:
             self.shutdown()
+
+    def _rejoin(self) -> bool:
+        """Reattach to whatever head now listens at the cluster address.
+
+        The dead head owned this node's task/actor state, so the daemon
+        reaps its workers (their in-flight work is unrecoverable — the
+        new head re-runs it via lineage/max_restarts) and re-registers
+        via the self-register/adopt path. The node id, shm store, and
+        object server are KEPT: the arena is named after the node id,
+        so a fresh id would strand every local zero-copy attach, and
+        peers can still drain already-sealed objects. Bounded
+        exponential backoff; False when the budget is exhausted.
+        """
+        import time
+
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        try:
+            self.pool.shutdown()
+        except Exception:
+            pass
+        host, port = self._driver_addr.rsplit(":", 1)
+        delay = 0.2
+        for attempt in range(self._rejoin_attempts):
+            if self._stopped.is_set():
+                return False
+            try:
+                sock = socket.create_connection((host, int(port)),
+                                                timeout=5)
+            except OSError:
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+                continue
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = FrameConn(sock)
+            info = {"self_register": True,
+                    "resources": dict(self._rejoin_resources),
+                    "num_workers": self._num_workers,
+                    "object_addr": self.object_server.address,
+                    "labels": {"rejoined": "1"}}
+            # Registration goes out BEFORE the conn is published to the
+            # telemetry loop / new worker pool: the head's accept loop
+            # closes any connection whose FIRST frame is not the
+            # registration, and both of those send concurrently.
+            if not conn.send(("register_node", self.node_id.binary(),
+                              os.getpid(), info)):
+                conn.close()
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+                continue
+            self.conn = conn
+            self.pool = WorkerPool(
+                self.node_id, size=self._num_workers,
+                message_handler=self._relay_from_worker,
+                on_worker_death=self._on_worker_death,
+                env=self._env,
+            )
+            sys.stderr.write(
+                "node_daemon: rejoined head at %s as %s (attempt %d)\n"
+                % (self._driver_addr, self.node_id.hex()[:8], attempt + 1))
+            return True
+        return False
 
     def _handle(self, msg: tuple) -> None:
         with _event_stats.measure(f"daemon.{msg[0]}"):
@@ -528,6 +619,14 @@ def main(argv=None) -> int:
     parser.add_argument("--resources-json", default="",
                         help="self-register with these resources (shell-"
                              "started daemons; the head adopts the node)")
+    parser.add_argument("--rejoin-attempts", type=int, default=0,
+                        help="on driver-connection loss, re-dial and "
+                             "re-register this many times (head-failover "
+                             "survival) instead of exiting")
+    parser.add_argument("--rejoin-resources-json", default="",
+                        help="resource shape to re-register with on "
+                             "rejoin (head-spawned daemons only know "
+                             "their resources driver-side)")
     args = parser.parse_args(argv)
 
     import json
@@ -539,6 +638,9 @@ def main(argv=None) -> int:
         NodeID.from_hex(args.node_id), args.driver,
         object_store_memory=args.store_memory or None,
         env=env, num_workers=args.num_workers, resources=resources,
+        rejoin_attempts=args.rejoin_attempts,
+        rejoin_resources=(json.loads(args.rejoin_resources_json)
+                          if args.rejoin_resources_json else None),
     )
     daemon.run()
     return 0

@@ -81,6 +81,17 @@ class _PGReady:
         return self._pg
 
 
+def _pg_descriptor(pg: PlacementGroup) -> dict:
+    """Durable projection of a PG (the live object carries an Event and
+    node references): enough to re-create and re-schedule it on a
+    replacement head. Old bundle_nodes and runtime state are
+    deliberately NOT persisted — a restored PG always re-runs
+    scheduling against the NEW node set, and removal deletes the
+    record outright."""
+    return {"id": pg.id.binary(), "bundles": [dict(b) for b in pg.bundles],
+            "strategy": pg.strategy, "name": pg.name}
+
+
 class PlacementGroupManager:
     """Schedules PGs over nodes (GcsPlacementGroupManager equivalent)."""
 
@@ -89,6 +100,21 @@ class PlacementGroupManager:
         self._groups: Dict[PlacementGroupID, PlacementGroup] = {}
         self._lock = threading.Lock()
 
+    def _persist(self, pg: PlacementGroup) -> None:
+        try:
+            self._rt.gcs.persist_placement_group(_pg_descriptor(pg))
+        except Exception:
+            pass  # durability never blocks scheduling; gcs logs/counts
+
+    def _install(self, pg: PlacementGroup) -> PlacementGroup:
+        """Shared tail of create/restore: register, schedule, persist."""
+        with self._lock:
+            self._groups[pg.id] = pg
+        self._try_schedule(pg)
+        self._rt.gcs.placement_groups[pg.id] = pg
+        self._persist(pg)
+        return pg
+
     def create(self, bundles: List[Dict[str, float]], strategy: str = "PACK",
                name: str = "") -> PlacementGroup:
         if not bundles:
@@ -96,13 +122,20 @@ class PlacementGroupManager:
         for b in bundles:
             if not b or any(v < 0 for v in b.values()):
                 raise ValueError(f"invalid bundle {b}")
-        pg = PlacementGroup(PlacementGroupID.from_random(), list(bundles),
-                            strategy, name)
+        return self._install(PlacementGroup(
+            PlacementGroupID.from_random(), list(bundles), strategy, name))
+
+    def restore(self, desc: dict) -> Optional[PlacementGroup]:
+        """Re-create a persisted PG on a replacement head (same id — so
+        recovered actors whose strategy captures this PG re-land in its
+        bundles) and re-run scheduling against the NEW node set."""
+        pg_id = PlacementGroupID(desc["id"])
         with self._lock:
-            self._groups[pg.id] = pg
-        self._try_schedule(pg)
-        self._rt.gcs.placement_groups[pg.id] = pg
-        return pg
+            if pg_id in self._groups:
+                return self._groups[pg_id]
+        return self._install(PlacementGroup(
+            pg_id, [dict(b) for b in desc["bundles"]],
+            desc.get("strategy", "PACK"), desc.get("name", "")))
 
     def _try_schedule(self, pg: PlacementGroup) -> None:
         """Reserve all bundles atomically; rollback on failure.
@@ -186,6 +219,10 @@ class PlacementGroupManager:
             if node is not None:
                 node.return_bundle(pg.id, idx)
         pg._set_state("REMOVED")
+        try:
+            self._rt.gcs.delete_placement_group(pg.id.binary())
+        except Exception:
+            pass
         self._rt.scheduler.notify()
 
     def get(self, pg_id: PlacementGroupID) -> Optional[PlacementGroup]:

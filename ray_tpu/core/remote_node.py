@@ -404,7 +404,12 @@ class RemoteNode:
              "--node-id", node_id.hex(),
              "--store-memory", str(object_store_memory or 0),
              "--num-workers", str(num_workers),
-             "--env-json", env_json],
+             "--env-json", env_json,
+             # Head-failover survival (0 = die with the head, default);
+             # the daemon re-registers with the node's REAL resource
+             # shape, which head-spawned daemons only know driver-side.
+             "--rejoin-attempts", str(config().daemon_rejoin_attempts),
+             "--rejoin-resources-json", json.dumps(resources)],
             cwd=repo_root, env=proc_env,
         )
         raw_conn, reg_info = accept_conn(node_id)  # blocks until registered

@@ -238,6 +238,11 @@ class Runtime:
         install_refcount_hooks(
             add=self._ref_added, remove=self._ref_removed, borrow=self._ref_added
         )
+        # Head failover: a replacement head started on the same WAL
+        # persist path reloads every control-plane table and reconciles
+        # (see _recover_control_plane). No-op without durable tables.
+        self.recovery_report: Optional[Dict[str, Any]] = None
+        self._recover_control_plane()
 
     # ------------------------------------------------------------------ nodes
     def add_node(self, resources: Dict[str, float],
@@ -286,7 +291,10 @@ class Runtime:
         srv = socket_mod.socket(socket_mod.AF_INET,
                                 socket_mod.SOCK_STREAM)
         srv.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1)
-        srv.bind((host or "127.0.0.1", port or 0))
+        # Fixed port (cluster_listener_port) lets daemons that outlive a
+        # dead head re-dial the SAME address and rejoin its replacement.
+        srv.bind((host or "127.0.0.1",
+                  port or config().cluster_listener_port or 0))
         srv.listen(64)
         self._cluster_listener = srv
         self._cluster_addr = "%s:%d" % srv.getsockname()[:2]
@@ -1053,6 +1061,185 @@ class Runtime:
                 e.creating_task = task_id
         self._schedule_task(record)
 
+    # ----------------------------------------------- head failover recovery
+    def _recover_control_plane(self) -> None:
+        """Reload the persisted actor/job/PG tables after a head restart
+        and reconcile them against this head's actually-alive cluster.
+
+        Reference: the GCS fault-tolerance path — GcsActorManager::
+        Initialize loads the actor table from storage and
+        ReconstructActor re-runs creation for actors whose workers are
+        gone. Here a replacement head started on the same
+        ``control_store_persist_path``:
+
+          1. replays the WAL (daemon-side) and scans the FSM tables,
+          2. closes jobs the dead head left RUNNING,
+          3. re-creates + re-schedules placement groups (same ids, new
+             node assignments),
+          4. for every non-DEAD actor whose worker no longer exists,
+             re-runs ``max_restarts`` logic: restartable actors go
+             RESTARTING and their creation is resubmitted (queued calls
+             buffer and complete after the restart); exhausted ones go
+             DEAD with a typed death cause. Named actors re-resolve via
+             the rebuilt name table + the WAL-durable handle KV.
+        """
+        restore = getattr(self.gcs, "restore_tables", None)
+        if restore is None or not getattr(
+                self.gcs, "supports_persistent_tables", False):
+            return
+        t0 = time.perf_counter()
+        try:
+            tables = restore()
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "control-plane table restore failed; starting empty",
+                exc_info=True)
+            return
+        report = {"actors_restarted": 0, "actors_dead": 0,
+                  "actors_seen": 0, "jobs_closed": 0, "pgs_restored": 0}
+        for job in tables["jobs"]:
+            if job.job_id == self.job_id:
+                continue
+            if job.status == "RUNNING":
+                # The owning driver died with the old head.
+                self.gcs.finish_job(job.job_id, "FAILED")
+                report["jobs_closed"] += 1
+        for desc in tables["pgs"]:
+            try:
+                if self.placement_group_manager.restore(desc) is not None:
+                    report["pgs_restored"] += 1
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "failed to restore placement group", exc_info=True)
+        for info in tables["actors"]:
+            if info.state == ActorState.DEAD:
+                # Tombstone: register a DEAD runtime record (no state
+                # change to persist) so durable handles keep failing
+                # TYPED with the stored death_cause on EVERY later
+                # failover, not just the one that killed the actor.
+                with self._lock:
+                    self._actors.setdefault(
+                        info.actor_id,
+                        _ActorRecord(info.actor_id, None,  # type: ignore[arg-type]
+                                     state=ActorState.DEAD,
+                                     restarts_left=0))
+                continue
+            report["actors_seen"] += 1
+            outcome = self._reconcile_recovered_actor(info)
+            report["actors_" + outcome] += 1
+        report["recovery_ms"] = round(
+            (time.perf_counter() - t0) * 1000.0, 2)
+        self.recovery_report = report
+        if not any((report["actors_seen"], report["jobs_closed"],
+                    report["pgs_restored"])):
+            return  # fresh WAL: nothing recovered, keep quiet
+        try:
+            from ..observability.events import emit
+
+            emit("HEAD_RECOVERY",
+                 f"recovered control plane in {report['recovery_ms']}ms: "
+                 f"{report['actors_restarted']} actors restarted, "
+                 f"{report['actors_dead']} dead (restarts exhausted or "
+                 f"unrecoverable), {report['jobs_closed']} jobs closed, "
+                 f"{report['pgs_restored']} placement groups rescheduled")
+        except Exception:
+            pass
+        if self._metrics is not None:
+            try:
+                from ..observability.metrics import Gauge, get_or_create
+
+                get_or_create(
+                    Gauge, "rt_head_recovery_ms",
+                    "Control-plane reload+reconcile time of the last "
+                    "head failover").set(report["recovery_ms"])
+                get_or_create(
+                    Gauge, "rt_head_recovered_actors",
+                    "Actors restarted by the last head failover").set(
+                    float(report["actors_restarted"]))
+            except Exception:
+                pass
+
+    def _reconcile_recovered_actor(self, info: ActorInfo) -> str:
+        """One persisted actor record → 'restarted' or 'dead'.
+
+        The dead head's workers are gone (a surviving daemon reaps them
+        before it rejoins), so every recovered actor lost its worker
+        while the head was down — exactly the window ``max_restarts``
+        must cover.
+        """
+        actor_id = info.actor_id
+        if info.creation_spec_blob is None:
+            return self._mark_recovered_dead(
+                info, None,
+                "head failover: no creation spec persisted")
+        try:
+            spec = serialization.loads(info.creation_spec_blob)
+        except Exception:
+            return self._mark_recovered_dead(
+                info, None,
+                "head failover: persisted creation spec unreadable")
+        if spec.arg_refs or spec.borrowed_refs:
+            # Creation args lived in the dead head's object plane and
+            # have no lineage here; re-running would hang on deps.
+            return self._mark_recovered_dead(
+                info, spec,
+                "head failover: creation arguments lost with the old "
+                "head")
+        if spec.strategy.kind == "NODE_AFFINITY" and not spec.strategy.soft:
+            # Hard affinity names a node of the dead head; this head's
+            # nodes have fresh ids, so the creation could never place —
+            # fail typed instead of pending forever.
+            return self._mark_recovered_dead(
+                info, spec,
+                "head failover: hard node affinity to a node of the "
+                "dead head")
+        if (spec.strategy.kind == "PLACEMENT_GROUP"
+                and self.placement_group_manager.get(
+                    spec.strategy.placement_group_id) is None):
+            # The PG record didn't survive (dropped write / unreadable):
+            # the creation would wait on a dangling bundle forever.
+            return self._mark_recovered_dead(
+                info, spec,
+                "head failover: placement group not recovered")
+        restarts_left = (-1 if spec.max_restarts < 0
+                         else max(0, spec.max_restarts - info.num_restarts))
+        if restarts_left == 0:
+            return self._mark_recovered_dead(
+                info, spec,
+                "worker died during head failover "
+                f"(max_restarts={spec.max_restarts} exhausted)")
+        if restarts_left > 0:
+            restarts_left -= 1  # this failover consumes one restart
+        record = _ActorRecord(actor_id, spec, state=ActorState.RESTARTING,
+                              restarts_left=restarts_left)
+        with self._lock:
+            self._actors[actor_id] = record
+        # update_actor(RESTARTING) bumps num_restarts and persists, so
+        # repeated failovers exhaust max_restarts exactly like repeated
+        # worker deaths under one head.
+        self.gcs.update_actor(actor_id, ActorState.RESTARTING)
+        self._schedule_actor_creation(record)
+        return "restarted"
+
+    def _mark_recovered_dead(self, info: ActorInfo,
+                             spec: Optional[TaskSpec],
+                             cause: str) -> str:
+        """Terminal reconcile outcome: record the death AND register a
+        DEAD _ActorRecord, so a surviving handle's submit takes the
+        normal dead-actor path (refs failed with a typed ActorDiedError
+        carrying the cause) instead of raising 'unknown actor'."""
+        record = _ActorRecord(info.actor_id, spec,  # type: ignore[arg-type]
+                              state=ActorState.DEAD, restarts_left=0)
+        with self._lock:
+            self._actors[info.actor_id] = record
+        self.gcs.update_actor(info.actor_id, ActorState.DEAD,
+                              death_cause=cause)
+        return "dead"
+
     # --------------------------------------------------------------- actors
     def _create_actor(self, spec: TaskSpec) -> List[ObjectRef]:
         if self._ctr_submitted is not None:
@@ -1063,8 +1250,17 @@ class Runtime:
         )
         with self._lock:
             self._actors[actor_id] = record
+        # With a durable control store, the creation spec travels with
+        # the actor record so a replacement head can re-run the creation
+        # (reference: gcs_actor_manager ReconstructActor needs the
+        # registered task spec). Skipped otherwise — serializing the
+        # spec again per creation is pure overhead without a WAL.
+        spec_blob = (serialization.dumps(spec)
+                     if getattr(self.gcs, "supports_persistent_tables",
+                                False) else None)
         self.gcs.register_actor(ActorInfo(
             actor_id, spec.name or None, max_restarts=spec.max_restarts,
+            creation_spec_blob=spec_blob,
         ))
         self._increment_arg_pins(spec)
         self._schedule_actor_creation(record)
@@ -1155,7 +1351,9 @@ class Runtime:
             self._mark_failed(oid, error)
         for spec in pending + in_flight:
             for oid in spec.return_ids():
-                self._mark_failed(oid, ActorDiedError(record.actor_id, str(error)))
+                self._mark_failed(oid, ActorDiedError(
+                    record.actor_id, "actor creation failed",
+                    death_cause=str(error)))
 
     def _submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
         # HOT PATH (one lock round, see _push_actor_task): a sync actor
@@ -1175,10 +1373,10 @@ class Runtime:
                 entry = self._objects.setdefault(oid, _ObjectEntry())
                 entry.creating_task = spec.task_id
             if record.state == ActorState.DEAD:
+                info = self.gcs.get_actor(spec.actor_id)
                 err = ActorDiedError(
-                    spec.actor_id,
-                    f"Actor is dead: "
-                    f"{self.gcs.get_actor(spec.actor_id).death_cause}",
+                    spec.actor_id, "Actor is dead",
+                    death_cause=info.death_cause if info else None,
                 )
                 for oid in spec.return_ids():
                     self._mark_failed(oid, err)
@@ -1266,7 +1464,8 @@ class Runtime:
         for spec in pending:
             for oid in spec.return_ids():
                 self._mark_failed(oid, ActorDiedError(
-                    actor_id, "actor terminated (handle out of scope)"))
+                    actor_id, "actor terminated",
+                    death_cause="all handles out of scope"))
         self._release_actor_resources(record)
         if worker is not None:
             if self._is_shared_hosted(record, worker):
@@ -1919,15 +2118,22 @@ class Runtime:
                             record.actor_id, "actor died; method not retried"))
             self._schedule_actor_creation(record)
         else:
+            max_restarts = record.creation_spec.max_restarts
+            cause = ("worker died (max_restarts=%d exhausted)" % max_restarts
+                     if max_restarts else "worker died")
             self.gcs.update_actor(record.actor_id, ActorState.DEAD,
-                                  death_cause="worker died")
+                                  death_cause=cause)
             self._release_actor_resources(record)
             with self._lock:
                 pending = list(record.pending)
                 record.pending = []
+            # Pending callers see a TYPED ActorDiedError carrying the
+            # death cause, not a bare "actor died" (reference:
+            # RayActorError + ActorDeathCause).
             for spec in in_flight + pending:
                 for oid in spec.return_ids():
-                    self._mark_failed(oid, ActorDiedError(record.actor_id))
+                    self._mark_failed(oid, ActorDiedError(
+                        record.actor_id, death_cause=cause))
         self.scheduler.notify()
 
     # ------------------------------------------------------------ cancel

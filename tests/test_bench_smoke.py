@@ -65,3 +65,17 @@ def test_bench_smoke_runs_all_stages():
     assert scrape["rt_workers_alive"] > 0, scrape
     assert scrape["rt_serve_requests_total"] > 0, scrape
     assert scrape["rt_serve_request_latency_count"] > 0, scrape
+
+    # Head-failover recovery stage: subprocess heads on a shared WAL —
+    # the chaos loop must actually kill and recover, committing latency.
+    # (The stage degrades gracefully on toolchain-less hosts, matching
+    # the build_native() skips of the dedicated failover tests.)
+    assert "head_failover_error" not in result, result
+    hf = result["head_failover"]
+    if hf.get("error") != "native toolchain unavailable":
+        assert "error" not in hf, hf
+        assert hf["kills"] >= 1, hf
+        assert hf["recoveries"] >= 1, hf
+        assert hf["actors_restarted_total"] >= 1, hf
+        assert hf["recover_ms_p50"] > 0, hf
+        assert hf["recover_ms_p99"] >= hf["recover_ms_p50"], hf
