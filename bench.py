@@ -178,6 +178,11 @@ def main():
         result["serve_llm_error"] = repr(e)[:300]
     gc.collect()
     try:
+        result["llm_sessions"] = bench_llm_sessions(on_tpu)
+    except Exception as e:
+        result["llm_sessions_error"] = repr(e)[:300]
+    gc.collect()
+    try:
         result["long_context"] = bench_long_context(on_tpu)
     except Exception as e:
         result["long_context_error"] = repr(e)[:300]
@@ -877,6 +882,114 @@ def bench_llm(on_tpu: bool) -> dict:
     return out
 
 
+def bench_llm_sessions(on_tpu: bool, smoke: bool = False) -> dict:
+    """Multi-turn chat serving over a SHARED system prompt (ISSUE 15 /
+    ROADMAP item 3): N sessions x M turns, every turn's prompt = system
+    prompt + the session's full history + a new user message — the
+    prefill-dominated regime production chat traffic lives in. The warm
+    pass lets the paged engine's radix prefix cache skip resident
+    prefill; the cold pass clears the index before every admission so
+    each request re-prefills from token zero. Reports submit-to-first-
+    token (TTFT) p50/p99 for both, the warm/cold speedup, and the warm
+    pass's prefix hit-rate out of the engine's own counters."""
+    import gc
+    import time as _t
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.llm.engine import SlotEngine
+    from ray_tpu.models import llama
+
+    if on_tpu:
+        model, slots, chunk, ps = "llama-1b", 8, 128, 16
+        sys_len, user_len, max_new = 512, 32, 64
+        n_sessions, m_turns = 8, 4
+        block = int(os.environ.get("BENCH_LLM_BLOCK", "16"))
+    else:
+        fast = smoke and os.environ.get("BENCH_SMOKE_FAST") == "1"
+        model, slots, chunk, ps = "llama-tiny", 4, 8, 8
+        sys_len, user_len, max_new = 48, 4, 4
+        n_sessions, m_turns = (2, 2) if fast else (3, 2)
+        block = 1
+    cfg = llama.CONFIGS[model]
+    params, _ = llama.init_params(jax.random.PRNGKey(0), cfg)
+    params = jax.tree.map(lambda x: x.astype(cfg.dtype), params)
+    # Pool sized with headroom over the slot footprint so the radix can
+    # keep every session's history resident across turns.
+    num_pages = (n_sessions + slots) * (cfg.max_seq // ps) + 1
+    engine = SlotEngine(params, cfg, num_slots=slots, chunk=chunk,
+                        decode_block=block, page_size=ps,
+                        num_pages=num_pages).start()
+    rng = np.random.default_rng(0)
+    sys_prompt = rng.integers(1, cfg.vocab_size, size=sys_len).tolist()
+    user_msgs = [[rng.integers(1, cfg.vocab_size,
+                               size=user_len).tolist()
+                  for _ in range(m_turns)] for _ in range(n_sessions)]
+
+    def run_pass(cold: bool) -> dict:
+        histories = [[] for _ in range(n_sessions)]
+        ttfts_ms, toks = [], 0
+        hits0, total0 = engine.prefix_hits, (engine.prefix_hits
+                                             + engine.prefix_misses)
+        t_pass = _t.perf_counter()
+        for turn in range(m_turns):
+            for sess in range(n_sessions):
+                if cold:
+                    engine.clear_prefix_cache()
+                prompt = (sys_prompt + histories[sess]
+                          + user_msgs[sess][turn])
+                t0 = _t.perf_counter()
+                h = engine.submit(prompt, max_new=max_new)
+                out = []
+                for tok in h:
+                    if not out:
+                        ttfts_ms.append((_t.perf_counter() - t0) * 1e3)
+                    out.append(tok)
+                toks += len(out)
+                histories[sess] += user_msgs[sess][turn] + out
+        dt = _t.perf_counter() - t_pass
+        total = (engine.prefix_hits + engine.prefix_misses) - total0
+        return {
+            "ttft_ms": percentiles(ttfts_ms),
+            "tokens_per_s": round(toks / dt, 1),
+            "hit_rate": round((engine.prefix_hits - hits0)
+                              / max(total, 1), 3),
+        }
+
+    try:
+        engine.warmup()
+        cold = run_pass(cold=True)
+        warm = run_pass(cold=False)
+    finally:
+        engine.stop()
+    out = {
+        "sessions": n_sessions, "turns": m_turns,
+        "sys_prompt_len": sys_len, "max_new": max_new,
+        "ttft_cold_ms_p50": cold["ttft_ms"]["p50"],
+        "ttft_cold_ms_p99": cold["ttft_ms"]["p99"],
+        "ttft_warm_ms_p50": warm["ttft_ms"]["p50"],
+        "ttft_warm_ms_p99": warm["ttft_ms"]["p99"],
+        "warm_ttft_speedup": round(
+            cold["ttft_ms"]["p50"] / max(warm["ttft_ms"]["p50"], 1e-9),
+            2),
+        "prefix_hit_rate": warm["hit_rate"],
+        "prefix_tokens_saved": engine.prefix_tokens_saved,
+        "tokens_per_s_cold": cold["tokens_per_s"],
+        "tokens_per_s_warm": warm["tokens_per_s"],
+        "pages_total": engine.pages_total,
+        "detail": (
+            f"{model} paged engine (page {ps}), {n_sessions} sessions x "
+            f"{m_turns} turns, shared {sys_len}-token system prompt + "
+            f"{user_len}-token user turns, {max_new} new tokens/turn, "
+            "greedy; cold = radix cleared before every admission, warm "
+            "= prefix cache live"),
+    }
+    del engine, params
+    gc.collect()
+    return out
+
+
 def bench_long_context(on_tpu: bool) -> dict:
     """Long-context training MFU on one chip: GPT-2 355M with flash
     attention at seq 4k/8k/16k, constant 16k tokens per step (VERDICT r4
@@ -1128,6 +1241,13 @@ def smoke() -> dict:
         result["serve_mixed"] = bench_serve_mixed(smoke=True)
     except Exception as e:  # noqa: BLE001
         result["serve_mixed_error"] = repr(e)[:300]
+    # Paged-KV multi-turn session stage: warm turns must beat cold ones
+    # on TTFT via the radix prefix cache (asserted by the smoke test so
+    # the scenario — and the cache — can't bitrot).
+    try:
+        result["llm_sessions"] = bench_llm_sessions(False, smoke=True)
+    except Exception as e:  # noqa: BLE001
+        result["llm_sessions_error"] = repr(e)[:300]
     # Mid-bench scrape while the runtime is still up: the stages above
     # must have left their marks in the cluster /metrics.
     try:

@@ -10,6 +10,7 @@ step 9).
 """
 
 from .engine import GenerationResult, RequestHandle, SlotEngine
+from .paged import OverloadedError, PagePool, RadixIndex
 from .serve import LLMServer, build_llm_app
 
 __all__ = [
@@ -18,4 +19,7 @@ __all__ = [
     "GenerationResult",
     "LLMServer",
     "build_llm_app",
+    "OverloadedError",
+    "PagePool",
+    "RadixIndex",
 ]

@@ -1,19 +1,31 @@
-"""Continuous-batching engine: a slot-based KV cache driven by two
-compiled programs.
+"""Continuous-batching engine: a PAGED KV cache driven by two compiled
+programs, with a radix prefix cache that skips redundant prefill.
 
 Design (TPU-first, static shapes throughout):
 
-- ``decode_slots`` advances EVERY slot one token per call with per-slot
-  positions; idle slots are parked at ``max_seq - 1`` where their
-  garbage writes are provably overwritten before ever being attended.
-- ``prefill_chunk`` writes one fixed-size prompt chunk into one slot's
-  pages. The host loop runs at most one chunk per iteration, so a long
-  prompt admission adds bounded latency to in-flight decodes (chunked
-  prefill, the vLLM scheduling insight re-expressed as two XLA programs
-  instead of a paged-attention kernel).
-- Sampling is fused into both programs — only ``[num_slots]`` int32
-  tokens cross the device boundary per step, never ``[B, vocab]``
-  logits.
+- The KV cache is a pool of fixed-size PAGES (``llama.init_paged_kv_cache``)
+  reached through a per-slot page table, not dense per-slot rows: a
+  request whose prompt prefix is already resident borrows those pages
+  read-only (refcounted) and starts prefill at the matched length; a
+  prefix dying mid-page is copied on write into a fresh page at
+  admission. Freed pages return to an LRU free-list; full prompt pages
+  are filed in a radix index keyed on page-size token chunks so the
+  NEXT turn of a session (or another session sharing the system prompt)
+  hits them. PagedAttention (vLLM) + RadixAttention (SGLang),
+  re-expressed as plain gather/scatter in the engine's
+  two-XLA-program style.
+- ``decode_slots_paged`` advances EVERY slot one token per call with
+  per-slot positions; idle slots are parked past ``max_seq`` where
+  their garbage writes are routed to the reserved scratch page.
+- The fused program additionally runs one fixed-size prompt chunk in
+  the same params read (chunked prefill), so a long prompt admission
+  adds bounded latency to in-flight decodes.
+- Sampling is fused into both programs and is DETERMINISTIC PER
+  REQUEST: token q of a request is drawn with
+  ``fold_in(PRNGKey(request_seed), q)``, so a prefix-hit admission
+  (fewer prefill dispatches) produces bit-for-bit the same output as a
+  cold one — only ``[num_slots]`` int32 tokens cross the device
+  boundary per step, never ``[B, vocab]`` logits.
 
 Exactly two compiled programs serve any mix of request lengths; there
 is no shape-dependent recompilation after warmup.
@@ -27,9 +39,9 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
-from functools import partial
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -37,13 +49,22 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import llama
+from .paged import OverloadedError, PagePool, RadixIndex, llm_metrics
 
 
-def _sample(logits, temps, key):
-    """Greedy when temp == 0, else temperature sampling. [B,V] -> [B]."""
+def _sample(logits, temps, seeds, qpos):
+    """Greedy when temp == 0, else temperature sampling with a
+    per-request deterministic stream: token index ``qpos`` of seed ``s``
+    always draws from ``fold_in(PRNGKey(s), qpos)`` — independent of
+    batching, decode blocking, or how much prefill a prefix hit
+    skipped. [B,V] -> [B]."""
     greedy = jnp.argmax(logits, axis=-1)
-    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    sampled = jax.random.categorical(key, scaled, axis=-1)
+
+    def one(lg, t, s, q):
+        key = jax.random.fold_in(jax.random.PRNGKey(s), q)
+        return jax.random.categorical(key, lg / jnp.maximum(t, 1e-6))
+
+    sampled = jax.vmap(one)(logits, temps, seeds, qpos)
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
@@ -110,10 +131,19 @@ class _Slot:
     temperature: float
     eos_id: Optional[int]
     on_token: Optional[Callable[[Optional[int]], None]]
+    seed: int = 0  # per-request sampling stream
+    submit_t: float = 0.0  # monotonic submit time (TTFT + queue timeout)
     prefill_offset: int = 0  # next chunk start; == len(prompt) when done
+    matched_len: int = 0  # prompt tokens whose prefill the radix skipped
     pos: int = 0  # write position of the NEXT decode step
     last_token: int = 0
     produced: int = 0
+    # Physical pages in logical order; the first ``shared_pages`` are
+    # borrowed read-only from the radix index (refcounted, never
+    # written), the rest are exclusively owned until freed.
+    pages: List[int] = field(default_factory=list)
+    shared_pages: int = 0
+    inserted: bool = False  # prompt pages filed in the radix index
     # True once this slot's current token lives on-device (row of the
     # previous decode block's `last` output) — its next block input
     # chains device-side with no host round trip.
@@ -129,17 +159,26 @@ class _Slot:
 
 
 class SlotEngine:
-    """Continuous-batching generation over a fixed pool of KV slots."""
+    """Continuous-batching generation over a paged KV-cache pool."""
 
     def __init__(self, params, cfg: llama.LlamaConfig, num_slots: int = 8,
-                 chunk: int = 64, seed: int = 0, decode_block: int = 1):
+                 chunk: int = 64, seed: int = 0, decode_block: int = 1,
+                 page_size: int = 16, num_pages: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 max_pending: Optional[int] = None,
+                 queue_timeout_s: Optional[float] = None):
         if cfg.max_seq % chunk != 0:
             raise ValueError(
                 f"chunk ({chunk}) must divide max_seq ({cfg.max_seq}): "
                 "a padded tail chunk would clamp past the cache end")
+        if cfg.max_seq % page_size != 0:
+            raise ValueError(
+                f"page_size ({page_size}) must divide max_seq "
+                f"({cfg.max_seq})")
         self.cfg = cfg
         self.num_slots = num_slots
         self.chunk = chunk
+        self.page_size = page_size
         # decode_block K > 1 amortizes the host<->device round trip: ONE
         # program advances every slot K tokens (an in-program lax.scan
         # chaining sampled tokens device-side), and the host fetches a
@@ -150,74 +189,92 @@ class SlotEngine:
         # and EOS is noticed up to 2K-1 tokens late (the overshoot is
         # discarded; garbage K/V is overwritten before ever attended).
         self.decode_block = decode_block
+        self.max_pending = max_pending
+        self.queue_timeout_s = queue_timeout_s
         self._params = jax.device_put(params)
-        # One extra SCRATCH slot: idle steps point the fused program's
-        # prefill lane at it, so inactive-prefill writes never touch a
-        # real request's pages. Requests only ever occupy slots
-        # [0, num_slots).
-        self._nrows = num_slots + 1
-        self._scratch = num_slots
-        self._cache = llama.init_kv_cache(cfg, self._nrows)
-        self._key = jax.random.PRNGKey(seed)
+        self._pages_per_seq = cfg.max_seq // page_size
+        # Pool default: exactly the dense footprint (num_slots full
+        # sequences) plus the single reserved scratch page — the old
+        # dense layout burned a whole scratch ROW (max_seq worth of KV)
+        # for idle prefill-lane parking; the scratch PAGE costs
+        # 1/pages_per_seq of that. Larger pools leave headroom for the
+        # radix index to keep evicted sessions' prefixes warm.
+        self._num_pages = (num_pages if num_pages is not None
+                           else num_slots * self._pages_per_seq + 1)
+        self._pool = PagePool(self._num_pages)
+        self._radix: Optional[RadixIndex] = (
+            RadixIndex(self._pool, page_size) if prefix_cache else None)
+        self._tables = np.zeros((num_slots, self._pages_per_seq),
+                                dtype=np.int32)
+        self._cache = llama.init_paged_kv_cache(cfg, self._num_pages,
+                                                page_size)
+        self._base_seed = seed
+        self._req_counter = 0
+        ps = page_size
 
-        def block_fn(params, cache, override_vals, override_mask,
-                     prev_last, pos, temps, key,
-                     pre_tokens, pre_slot, pre_p0, pre_last_idx,
-                     pre_temp):
+        def block_fn(params, cache, tables, override_vals, override_mask,
+                     prev_last, pos, temps, seeds,
+                     pre_tokens, pre_slot, pre_p0, pre_n_valid,
+                     pre_temp, pre_seed):
             """K-token decode block with the prefill lane fused into the
-            FIRST step (decode_slots_with_prefill): a prompt chunk rides
-            the same params read as the decode batch, so prefill no
-            longer costs a separate full-model pass."""
+            FIRST step (decode_slots_with_prefill_paged): a prompt chunk
+            rides the same params read as the decode batch, so prefill
+            no longer costs a separate full-model pass."""
             tokens0 = jnp.where(override_mask, override_vals, prev_last)
-            key, k0, kp = jax.random.split(key, 3)
             dec_logits, pre_logits, cache = \
-                llama.decode_slots_with_prefill(
-                    params, cache, tokens0, pos, pre_tokens, pre_slot,
-                    pre_p0, pre_last_idx, cfg)
-            tok1 = _sample(dec_logits, temps, k0)
-            pre_tok = _sample(pre_logits[None], pre_temp[None], kp)[0]
+                llama.decode_slots_with_prefill_paged(
+                    params, cache, tables, tokens0, pos, pre_tokens,
+                    pre_slot, pre_p0, pre_n_valid, cfg, ps)
+            tok1 = _sample(dec_logits, temps, seeds, pos + 1)
+            pre_tok = _sample(pre_logits[None], pre_temp[None],
+                              pre_seed[None],
+                              (pre_p0 + pre_n_valid)[None])[0]
 
             def body(carry, _):
-                toks, cache, p, key = carry
-                key, sub = jax.random.split(key)
-                logits, cache = llama.decode_slots(params, cache, toks, p,
-                                                   cfg)
-                nxt = _sample(logits, temps, sub)
-                return (nxt, cache, p + 1, key), nxt
+                toks, cache, p = carry
+                logits, cache = llama.decode_slots_paged(
+                    params, cache, tables, toks, p, cfg, ps)
+                nxt = _sample(logits, temps, seeds, p + 1)
+                return (nxt, cache, p + 1), nxt
 
-            (last, cache, _, _), toks_rest = jax.lax.scan(
-                body, (tok1, cache, pos + 1, key), None,
+            (last, cache, _), toks_rest = jax.lax.scan(
+                body, (tok1, cache, pos + 1), None,
                 length=decode_block - 1)
             toks_k = jnp.concatenate([tok1[None], toks_rest], axis=0)
             return toks_k, last, pre_tok, cache
 
-        def decode_only_fn(params, cache, override_vals, override_mask,
-                           prev_last, pos, temps, key):
+        def decode_only_fn(params, cache, tables, override_vals,
+                           override_mask, prev_last, pos, temps, seeds):
             """Pure K-step decode block — dispatched whenever no prompt
             chunk is pending, so idle steps never pay the fused
             program's C-token prefill lane."""
             tokens0 = jnp.where(override_mask, override_vals, prev_last)
 
             def body(carry, _):
-                toks, cache, p, key = carry
-                key, sub = jax.random.split(key)
-                logits, cache = llama.decode_slots(params, cache, toks, p,
-                                                   cfg)
-                nxt = _sample(logits, temps, sub)
-                return (nxt, cache, p + 1, key), nxt
+                toks, cache, p = carry
+                logits, cache = llama.decode_slots_paged(
+                    params, cache, tables, toks, p, cfg, ps)
+                nxt = _sample(logits, temps, seeds, p + 1)
+                return (nxt, cache, p + 1), nxt
 
-            (last, cache, _, _), toks_k = jax.lax.scan(
-                body, (tokens0, cache, pos, key), None,
-                length=decode_block)
+            (last, cache, _), toks_k = jax.lax.scan(
+                body, (tokens0, cache, pos), None, length=decode_block)
             return toks_k, last, cache
 
         # The cache is donated: XLA updates it in place, so a decode
         # step never copies the (potentially multi-GB) KV pages.
         self._block = jax.jit(block_fn, donate_argnums=(1,))
         self._decode_only = jax.jit(decode_only_fn, donate_argnums=(1,))
+        self._copy_pages = jax.jit(llama.copy_pages, donate_argnums=(0,))
+        # Pre-compile the COW page-copy program NOW, while no engine
+        # thread can be touching the (donated) cache: the first partial
+        # prefix hit must not stall on a compile, and compiling from
+        # warmup() would race a running engine thread's dispatches.
+        zero = jnp.zeros((1,), jnp.int32)
+        self._cache = self._copy_pages(self._cache, zero, zero)
         # lag-1 decode pipeline state
         self._inflight = None  # (snapshot, pre_info, toks_k, pre_tok)
-        self._last_dev = jnp.zeros((self._nrows,), jnp.int32)
+        self._last_dev = jnp.zeros((num_slots,), jnp.int32)
 
         self._slots: List[Optional[_Slot]] = [None] * num_slots
         self._pending: deque = deque()
@@ -228,13 +285,17 @@ class SlotEngine:
         # counters (observability / autoscaling signals)
         self.tokens_generated = 0
         self.requests_completed = 0
+        self.requests_shed = 0
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_tokens_saved = 0
 
     # -- public API --------------------------------------------------------
 
     def submit(self, prompt: Sequence[int], max_new: int = 64,
                temperature: float = 0.0, eos_id: Optional[int] = None,
                on_token: Optional[Callable[[Optional[int]], None]] = None,
-               ) -> RequestHandle:
+               seed: Optional[int] = None) -> RequestHandle:
         prompt = np.asarray(prompt, dtype=np.int32)
         if prompt.ndim != 1 or len(prompt) == 0:
             raise ValueError("prompt must be a non-empty 1D token list")
@@ -242,11 +303,33 @@ class SlotEngine:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new ({max_new}) exceeds "
                 f"max_seq ({self.cfg.max_seq})")
+        n_total = -(-(len(prompt) + max_new) // self.page_size)
+        if n_total > self._num_pages - 1:
+            # Admission reserves the worst-case footprint; a request the
+            # pool can never cover would head-of-line block the FIFO
+            # queue forever. Reject it at the door instead.
+            raise ValueError(
+                f"request needs {n_total} KV pages but the pool only "
+                f"has {self._num_pages - 1} allocatable")
         handle = RequestHandle(len(prompt))
         slot = _Slot(handle=handle, prompt=prompt, max_new=max_new,
                      temperature=float(temperature), eos_id=eos_id,
-                     on_token=on_token)
+                     on_token=on_token, submit_t=time.monotonic())
         with self._work:
+            if (self.max_pending is not None
+                    and len(self._pending) >= self.max_pending):
+                self.requests_shed += 1
+                raise OverloadedError(
+                    f"engine overloaded: {len(self._pending)} requests "
+                    f"pending (max_pending={self.max_pending})")
+            self._req_counter += 1
+            # Masked to int32 range either way: the seed rides a
+            # np.int32 vector into the compiled program, and an
+            # out-of-range user seed must not OverflowError the engine
+            # thread (which would fail every tenant's request).
+            slot.seed = (int(seed) if seed is not None else
+                         self._base_seed * 1000003
+                         + self._req_counter) & 0x7FFFFFFF
             self._pending.append(slot)
             self._work.notify()
         return handle
@@ -278,6 +361,37 @@ class SlotEngine:
                 break
         h.result(timeout=0)
 
+    # -- paged-pool introspection -----------------------------------------
+
+    @property
+    def pages_total(self) -> int:
+        return self._pool.num_pages
+
+    @property
+    def pages_used(self) -> int:
+        return self._pool.used_count
+
+    @property
+    def pages_free(self) -> int:
+        return self._pool.free_count
+
+    def prefix_cache_len(self) -> int:
+        return 0 if self._radix is None else len(self._radix)
+
+    def clear_prefix_cache(self) -> int:
+        """Drop every radix entry (and the pages only it held). Returns
+        pages freed; used for cold-run benching and tests."""
+        with self._lock:
+            freed = 0 if self._radix is None else self._radix.clear()
+            self._publish_page_gauges()
+            return freed
+
+    def _publish_page_gauges(self) -> None:
+        m = llm_metrics()
+        if m is not None:
+            m["pages_used"].set(float(self._pool.used_count))
+            m["pages_free"].set(float(self._pool.free_count))
+
     # -- engine loop -------------------------------------------------------
 
     def _run(self) -> None:
@@ -299,10 +413,18 @@ class SlotEngine:
         return (bool(self._pending) or self._inflight is not None
                 or any(s is not None for s in self._slots))
 
+    def _release_slot_pages_locked(self, s: _Slot) -> None:
+        for pg in s.pages:
+            self._pool.unref(pg)
+        s.pages = []
+        s.shared_pages = 0
+
     def _fail_all_locked(self, err: BaseException) -> None:
         self._inflight = None
         for i, s in enumerate(self._slots):
             if s is not None:
+                self._release_slot_pages_locked(s)
+                self._tables[i] = 0
                 s.handle._finish("error", err)
                 if s.on_token:
                     s.on_token(None)
@@ -312,6 +434,110 @@ class SlotEngine:
             s.handle._finish("error", err)
             if s.on_token:
                 s.on_token(None)
+        self._publish_page_gauges()
+
+    # -- admission (paged + radix match) -----------------------------------
+
+    def _shed_expired_locked(self) -> None:
+        if self.queue_timeout_s is None:
+            return
+        now = time.monotonic()
+        while self._pending and (now - self._pending[0].submit_t
+                                 > self.queue_timeout_s):
+            s = self._pending.popleft()
+            self.requests_shed += 1
+            s.handle._finish("error", OverloadedError(
+                f"engine overloaded: request queued longer than "
+                f"queue_timeout_s={self.queue_timeout_s}"))
+            if s.on_token:
+                s.on_token(None)
+
+    def _admit_locked(self, idx: int, s: _Slot) -> bool:
+        """Install a pending request into slot ``idx``: radix-match its
+        prompt, borrow the matched pages read-only, COW-copy a partial
+        tail page, and eagerly allocate the rest of its worst-case
+        footprint (prompt + max_new). Returns False — leaving the
+        request pending, FIFO order preserved — when even after LRU
+        eviction the pool cannot cover it."""
+        ps = self.page_size
+        n_total = -(-(len(s.prompt) + s.max_new) // ps)
+        full_pages: List[int] = []
+        partial = None
+        if self._radix is not None:
+            full_pages, partial = self._radix.match(s.prompt)
+            # The engine needs the LAST prompt token's logits to sample
+            # the first output, so at least one prompt token must
+            # prefill: cap the match at len(prompt) - 1.
+            while len(full_pages) * ps >= len(s.prompt):
+                full_pages.pop()
+                partial = None
+            if partial is not None:
+                cap = len(s.prompt) - 1 - len(full_pages) * ps
+                if min(partial[1], cap) <= 0:
+                    partial = None
+                else:
+                    partial = (partial[0], min(partial[1], cap))
+        # Borrow refs BEFORE any eviction so the matched nodes stop
+        # being eviction candidates (their refcount leaves 1).
+        for pg in full_pages:
+            self._pool.ref(pg)
+        if partial is not None:
+            self._pool.ref(partial[0])
+        n_fresh = n_total - len(full_pages)
+        if self._pool.free_count < n_fresh and self._radix is not None:
+            self._radix.evict(n_fresh - self._pool.free_count)
+        if self._pool.free_count < n_fresh and partial is not None:
+            # A full-page borrow is feasibility-neutral (it pins one
+            # page but also saves one fresh page), but the partial
+            # borrow pins its source WITHOUT reducing n_fresh — the COW
+            # copy lands in a fresh page. For a request whose footprint
+            # needs the whole pool that pin makes admission impossible
+            # forever (the pinned page can never be evicted), so drop
+            # the partial match and retry before giving up.
+            self._pool.unref(partial[0])
+            partial = None
+            if self._radix is not None:
+                self._radix.evict(n_fresh - self._pool.free_count)
+        if self._pool.free_count < n_fresh:
+            for pg in full_pages:  # rollback the borrow; stay pending
+                self._pool.unref(pg)
+            if partial is not None:
+                self._pool.unref(partial[0])
+            return False
+        fresh = [self._pool.alloc() for _ in range(n_fresh)]
+        s.pages = full_pages + fresh
+        s.shared_pages = len(full_pages)
+        s.matched_len = len(full_pages) * ps
+        if partial is not None:
+            # Copy-on-write: the borrowed page's first n tokens are
+            # reused, but this slot will write the rest of that page —
+            # device-copy it into the slot's own fresh page, then drop
+            # the temporary borrow ref.
+            src, n_tok = partial
+            dst = fresh[0]
+            self._cache = self._copy_pages(
+                self._cache, jnp.asarray([src], jnp.int32),
+                jnp.asarray([dst], jnp.int32))
+            self._pool.unref(src)
+            s.matched_len += n_tok
+        self._tables[idx, :n_total] = s.pages
+        self._tables[idx, n_total:] = 0
+        s.prefill_offset = s.matched_len
+        s.pos = 0
+        hit = s.matched_len > 0
+        if hit:
+            self.prefix_hits += 1
+            self.prefix_tokens_saved += s.matched_len
+        else:
+            self.prefix_misses += 1
+        m = llm_metrics()
+        if m is not None:
+            m["prefix"].inc(tags={"result": "hit" if hit else "miss"})
+            if hit:
+                m["prefix_tokens"].inc(s.matched_len)
+        self._publish_page_gauges()
+        self._slots[idx] = s
+        return True
 
     def step(self) -> bool:
         """One scheduler iteration: admit, dispatch a fused
@@ -319,9 +545,12 @@ class SlotEngine:
         (ready by now — lag-1 pipelining). Returns True if any work
         ran."""
         with self._lock:
+            self._shed_expired_locked()
             for i in range(self.num_slots):
                 if self._slots[i] is None and self._pending:
-                    self._slots[i] = self._pending.popleft()
+                    if not self._admit_locked(i, self._pending[0]):
+                        break  # pool exhausted; FIFO order preserved
+                    self._pending.popleft()
             prefill_idx = next(
                 (i for i, s in enumerate(self._slots)
                  if s is not None and not s.prefill_done), None)
@@ -339,10 +568,6 @@ class SlotEngine:
             ran = True
         return ran
 
-    def _next_key(self):
-        self._key, sub = jax.random.split(self._key)
-        return sub
-
     def _dispatch_block(self, active, prefill_idx):
         """Dispatch one K-step block: every active slot decodes K
         tokens and (when a slot is mid-prompt) ONE prefill chunk rides
@@ -350,24 +575,31 @@ class SlotEngine:
         input token device-side; freshly prefilled slots inject theirs
         via the override vector."""
         cfg = self.cfg
-        rows = self._nrows
+        rows = self.num_slots
         override_vals = np.zeros((rows,), dtype=np.int32)
         override_mask = np.ones((rows,), dtype=bool)
-        pos = np.full((rows,), cfg.max_seq - 1, dtype=np.int32)
+        # Parked rows sit AT max_seq: the paged scatter routes any write
+        # at pos >= max_seq to the scratch page, so a parked row can
+        # never touch a live (possibly shared) page.
+        pos = np.full((rows,), cfg.max_seq, dtype=np.int32)
         temps = np.zeros((rows,), dtype=np.float32)
+        seeds = np.zeros((rows,), dtype=np.int32)
         for i, s in active:
             pos[i] = s.pos
             temps[i] = s.temperature
+            seeds[i] = s.seed
             if s.on_device_chain:
                 override_mask[i] = False
             else:
                 override_vals[i] = s.last_token
+        tables = jnp.asarray(self._tables)
         if prefill_idx is None:
             # No prompt chunk pending: the cheap pure-decode program.
             toks_k, self._last_dev, self._cache = self._decode_only(
-                self._params, self._cache, jnp.asarray(override_vals),
-                jnp.asarray(override_mask), self._last_dev,
-                jnp.asarray(pos), jnp.asarray(temps), self._next_key())
+                self._params, self._cache, tables,
+                jnp.asarray(override_vals), jnp.asarray(override_mask),
+                self._last_dev, jnp.asarray(pos), jnp.asarray(temps),
+                jnp.asarray(seeds))
             for i, s in active:
                 s.pos += self.decode_block
                 s.on_device_chain = True
@@ -386,13 +618,14 @@ class SlotEngine:
             s.first_tok_pending = True
         pre_info = (prefill_idx, s, final)
         toks_k, self._last_dev, pre_tok, self._cache = self._block(
-            self._params, self._cache, jnp.asarray(override_vals),
-            jnp.asarray(override_mask), self._last_dev, jnp.asarray(pos),
-            jnp.asarray(temps), self._next_key(),
+            self._params, self._cache, tables,
+            jnp.asarray(override_vals), jnp.asarray(override_mask),
+            self._last_dev, jnp.asarray(pos), jnp.asarray(temps),
+            jnp.asarray(seeds),
             jnp.asarray(pre_buf), jnp.asarray(prefill_idx, jnp.int32),
-            jnp.asarray(p0, jnp.int32),
-            jnp.asarray(n_valid - 1, jnp.int32),
-            jnp.asarray(s.temperature, jnp.float32))
+            jnp.asarray(p0, jnp.int32), jnp.asarray(n_valid, jnp.int32),
+            jnp.asarray(s.temperature, jnp.float32),
+            jnp.asarray(s.seed, jnp.int32))
         for i, s in active:
             s.pos += self.decode_block
             s.on_device_chain = True
@@ -412,6 +645,15 @@ class SlotEngine:
         if pre_info is not None:
             idx, s, final = pre_info
             if final and self._slots[idx] is s:
+                # Prefill complete: file the prompt's fully-covered
+                # pages in the radix index NOW (not at request end), so
+                # a concurrent same-prefix admission already hits them.
+                if self._radix is not None and not s.inserted:
+                    with self._lock:
+                        self._radix.insert(
+                            s.prompt, s.pages[:len(s.prompt)
+                                              // self.page_size])
+                    s.inserted = True
                 # The prompt's sampled first token arrives with the
                 # block fetch; the slot joins the decode batch next
                 # dispatch (override lane — the token is host-side).
@@ -424,6 +666,10 @@ class SlotEngine:
         s.last_token = tok
         s.produced += 1
         self.tokens_generated += 1
+        if s.produced == 1:
+            m = llm_metrics()
+            if m is not None:
+                m["ttft"].observe(time.monotonic() - s.submit_t)
         s.handle._emit(tok)
         if s.on_token:
             s.on_token(tok)
@@ -435,4 +681,7 @@ class SlotEngine:
                 s.on_token(None)
             self.requests_completed += 1
             with self._lock:
+                self._release_slot_pages_locked(s)
+                self._tables[idx] = 0
                 self._slots[idx] = None
+                self._publish_page_gauges()

@@ -25,6 +25,7 @@ import jax
 
 from ..models import llama
 from .engine import SlotEngine
+from .paged import OverloadedError
 
 
 def _build_params(model: str, seed: int,
@@ -52,11 +53,24 @@ class LLMServer:
     def __init__(self, model: str = "llama-tiny", num_slots: int = 8,
                  chunk: int = 64, seed: int = 0,
                  checkpoint_path: Optional[str] = None,
-                 default_max_tokens: int = 64):
+                 default_max_tokens: int = 64,
+                 page_size: int = 16, num_pages: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 max_pending: Optional[int] = 256,
+                 queue_timeout_s: Optional[float] = 30.0):
         params, cfg = _build_params(model, seed, checkpoint_path)
         self.default_max_tokens = default_max_tokens
+        # Per-deployment admission control: the pending queue is BOUNDED
+        # (max_pending) and queued requests expire after queue_timeout_s
+        # — both shed load as a typed OverloadedError that the HTTP
+        # proxy maps to 503, instead of letting a traffic wave grow
+        # engine._pending without limit and stall resident sessions.
         self.engine = SlotEngine(params, cfg, num_slots=num_slots,
-                                 chunk=chunk, seed=seed)
+                                 chunk=chunk, seed=seed,
+                                 page_size=page_size, num_pages=num_pages,
+                                 prefix_cache=prefix_cache,
+                                 max_pending=max_pending,
+                                 queue_timeout_s=queue_timeout_s)
         self.engine.warmup()  # compile before the replica is routable
         self.engine.start()
 
@@ -82,14 +96,23 @@ class LLMServer:
             eos_id=None if eos_id is None else int(eos_id),
             on_token=lambda t: loop.call_soon_threadsafe(q.put_nowait, t))
         if payload.get("stream"):
+            # Hold the response until the FIRST token (or failure): the
+            # proxy writes the chunked 200 header as soon as it sees a
+            # stream, so an admission shed surfacing after that point
+            # could only be reported as a dropped connection. Raising
+            # here instead lets the proxy send the typed 503. TTFB was
+            # going to be the first token anyway.
+            first = await q.get()
+            if first is None and handle.error is not None:
+                raise handle.error
+
             async def token_stream():
-                while True:
-                    tok = await q.get()
-                    if tok is None:
-                        if handle.error is not None:
-                            raise handle.error
-                        return
+                tok = first
+                while tok is not None:
                     yield tok
+                    tok = await q.get()
+                if handle.error is not None:
+                    raise handle.error
 
             return token_stream()
         while True:
@@ -105,17 +128,31 @@ class LLMServer:
         return {
             "tokens_generated": self.engine.tokens_generated,
             "requests_completed": self.engine.requests_completed,
+            "requests_shed": self.engine.requests_shed,
             "num_slots": self.engine.num_slots,
+            "prefix_hits": self.engine.prefix_hits,
+            "prefix_misses": self.engine.prefix_misses,
+            "prefix_tokens_saved": self.engine.prefix_tokens_saved,
+            "pages_used": self.engine.pages_used,
+            "pages_free": self.engine.pages_free,
         }
 
 
 def build_llm_app(model: str = "llama-tiny", num_slots: int = 8,
                   chunk: int = 64, seed: int = 0,
                   checkpoint_path: Optional[str] = None,
-                  name: str = "llm", **deploy_opts):
+                  name: str = "llm", page_size: int = 16,
+                  num_pages: Optional[int] = None,
+                  prefix_cache: bool = True,
+                  max_pending: Optional[int] = 256,
+                  queue_timeout_s: Optional[float] = 30.0,
+                  **deploy_opts):
     """Build a Serve application for ``serve.run`` hosting the engine."""
     from ..serve import deployment
 
     dep = deployment(LLMServer, name=name, **deploy_opts)
     return dep.bind(model=model, num_slots=num_slots, chunk=chunk,
-                    seed=seed, checkpoint_path=checkpoint_path)
+                    seed=seed, checkpoint_path=checkpoint_path,
+                    page_size=page_size, num_pages=num_pages,
+                    prefix_cache=prefix_cache, max_pending=max_pending,
+                    queue_timeout_s=queue_timeout_s)

@@ -453,6 +453,249 @@ def prefill_chunk(params, cache, tokens, slot, p0, cfg: LlamaConfig,
     return _lm_head(x[0], params, cfg), cache
 
 
+# ---------------------------------------------------------------------------
+# Paged KV cache (serve path v2): fixed-size pages + slot->page-table
+# indirection, so prompt-prefix pages can be SHARED between slots
+# (radix/prefix cache, refcounted by the engine) and freed pages return
+# to a pool instead of dying with a slot. PagedAttention (vLLM) /
+# RadixAttention (SGLang) re-expressed in this repo's two-XLA-program
+# style: plain gather/scatter by physical page id, no custom kernel.
+#
+# Layout: cache[k|v] is [L, num_pages, Hkv, page_size, hd]; a page table
+# row [P] (P = max_seq // page_size) maps a slot's logical page l to a
+# physical page id. Physical page 0 is the RESERVED SCRATCH page: every
+# invalid write (parked slots, chunk tail padding, position overshoot)
+# is routed there explicitly, so garbage can never land in a real —
+# possibly shared — page. Unallocated page-table entries are 0 for the
+# same reason. Positions in unallocated logical pages are always
+# > the slot's current pos, so attention masks them before they are
+# ever read.
+# ---------------------------------------------------------------------------
+
+def init_paged_kv_cache(cfg: LlamaConfig, num_pages: int, page_size: int):
+    if cfg.max_seq % page_size != 0:
+        raise ValueError(
+            f"page_size ({page_size}) must divide max_seq ({cfg.max_seq})")
+    shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page_size,
+             cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def _gather_pages(cache_l, tables, cfg: LlamaConfig):
+    """[NP, Hkv, ps, hd] gathered by tables [B, P] -> [B, Hkv, S, hd].
+
+    The gathered view puts logical page l's slot (offset o) at sequence
+    position l * ps + o, so positions/masks are identical to the dense
+    layout — the paths differ only in where bytes physically live."""
+    b, p = tables.shape
+    kp = cache_l[tables]  # [B, P, Hkv, ps, hd]
+    ps = kp.shape[3]
+    return kp.transpose(0, 2, 1, 3, 4).reshape(
+        b, cfg.num_kv_heads, p * ps, kp.shape[4])
+
+
+def _scatter_token_kv(k_cache, v_cache, kn, vn, tables, rows, pos,
+                      page_size: int, max_seq: int):
+    """Scatter one token per row: row r's K/V lands in physical page
+    tables[rows[r], pos[r] // ps] at offset pos[r] % ps. Writes at
+    pos >= max_seq (parked rows / overshoot) are routed to the scratch
+    page so they can never corrupt a live page. kn/vn: [B, Hkv, hd]."""
+    p = tables.shape[1]
+    valid = pos < max_seq
+    lpage = jnp.minimum(pos // page_size, p - 1)
+    phys = jnp.where(valid, tables[rows, lpage], 0)
+    off = jnp.where(valid, pos % page_size, 0)
+    return (k_cache.at[phys, :, off, :].set(kn),
+            v_cache.at[phys, :, off, :].set(vn))
+
+
+def decode_slots_paged(params, cache, tables, tokens, pos,
+                       cfg: LlamaConfig, page_size: int):
+    """``decode_slots`` over a paged cache: one decode step with
+    per-slot positions, gathering each slot's pages through its page
+    table row and scattering the new K/V by physical page id.
+
+    tables [B, P] int32, tokens [B] int32, pos [B] int32. Returns
+    (logits [B, vocab] fp32, new_cache). Parked slots (pos >= max_seq,
+    or any slot whose table row is all-scratch) write garbage only into
+    the scratch page."""
+    b = tokens.shape[0]
+    x = params["wte"][tokens].astype(cfg.dtype)[:, None, :]  # [B,1,D]
+    positions = pos[:, None]
+    kv_mask = (jnp.arange(cfg.max_seq)[None, None, None, None, :]
+               <= pos[:, None, None, None, None])
+    rows = jnp.arange(b)
+
+    def layer_step(x, inputs):
+        p, k_cache, v_cache = inputs
+
+        def write(kn, vn):
+            return _scatter_token_kv(
+                k_cache, v_cache, kn[:, :, 0, :], vn[:, :, 0, :],
+                tables, rows, pos, page_size, cfg.max_seq)
+
+        def view(kc, vc):
+            return (_gather_pages(kc, tables, cfg),
+                    _gather_pages(vc, tables, cfg))
+
+        x, k2, v2 = _cache_layer_step(x, p, cfg, positions, kv_mask,
+                                      write, view)
+        return x, (k2, v2)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["blocks"], cache["k"], cache["v"]))
+    return _lm_head(x[:, 0], params, cfg), {"k": new_k, "v": new_v}
+
+
+def prefill_chunk_paged(params, cache, tables, tokens, slot, p0, n_valid,
+                        cfg: LlamaConfig, page_size: int):
+    """``prefill_chunk`` over a paged cache: write one C-token prompt
+    chunk into ``slot``'s pages (chunk may straddle page boundaries —
+    each token's physical destination is computed independently) and
+    return the final valid position's logits.
+
+    tokens [C] int32 (tail padding allowed), slot / p0 / n_valid scalar
+    int32. Tokens at index >= n_valid are routed to the scratch page, so
+    chunk-tail garbage never lands in a real page regardless of how the
+    chunk aligns to pages. Returns ([vocab] logits of chunk index
+    n_valid - 1, new_cache)."""
+    h, hd, hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
+    c = tokens.shape[0]
+    p = tables.shape[1]
+    x = params["wte"][tokens].astype(cfg.dtype)[None]  # [1,C,D]
+    idx = jnp.arange(c)
+    abs_pos = p0 + idx
+    positions = abs_pos[None, :]
+    kv_mask = (jnp.arange(cfg.max_seq)[None, None, None, None, :]
+               <= abs_pos[None, None, None, :, None])
+    cvalid = (idx < n_valid) & (abs_pos < cfg.max_seq)
+    lpage = jnp.minimum(abs_pos // page_size, p - 1)
+    phys = jnp.where(cvalid, tables[slot, lpage], 0)
+    off = jnp.where(cvalid, abs_pos % page_size, 0)
+    slot_table = jax.lax.dynamic_slice(tables, (slot, 0), (1, p))
+
+    def layer_step(x, inputs):
+        pr, k_cache, v_cache = inputs
+
+        def write(kn, vn):
+            # kn/vn: [1, Hkv, C, hd] -> per-token scatter [C, Hkv, hd]
+            return (k_cache.at[phys, :, off, :].set(
+                        kn[0].transpose(1, 0, 2)),
+                    v_cache.at[phys, :, off, :].set(
+                        vn[0].transpose(1, 0, 2)))
+
+        def view(kc, vc):
+            return (_gather_pages(kc, slot_table, cfg),
+                    _gather_pages(vc, slot_table, cfg))
+
+        x, k2, v2 = _cache_layer_step(x, pr, cfg, positions, kv_mask,
+                                      write, view)
+        return x, (k2, v2)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["blocks"], cache["k"], cache["v"]))
+    row = jax.lax.dynamic_index_in_dim(x[0], n_valid - 1, 0,
+                                       keepdims=False)
+    return (_lm_head(row[None], params, cfg)[0],
+            {"k": new_k, "v": new_v})
+
+
+def decode_slots_with_prefill_paged(params, cache, tables, tokens, pos,
+                                    pre_tokens, pre_slot, pre_p0,
+                                    pre_n_valid, cfg: LlamaConfig,
+                                    page_size: int):
+    """Fused continuous-batching step over the PAGED cache — the paged
+    twin of ``decode_slots_with_prefill``: B decode tokens and one
+    C-token prefill chunk share every weight matmul; only attention and
+    the K/V landing sites split. Decode rows scatter one token each by
+    page id; the chunk scatters per token into ``pre_slot``'s pages
+    (straddling page boundaries freely); invalid writes (parked rows,
+    chunk tail at index >= pre_n_valid) go to the scratch page.
+
+    The caller guarantees pre_slot is not an active decode row this
+    step, so the two scatter groups touch disjoint pages. Returns
+    (dec_logits [B, vocab], pre_logits [vocab], new_cache)."""
+    b = tokens.shape[0]
+    c = pre_tokens.shape[0]
+    h, hd, hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
+    s_max = cfg.max_seq
+    p = tables.shape[1]
+    packed = jnp.concatenate([tokens, pre_tokens])
+    x = params["wte"][packed].astype(cfg.dtype)[None]  # [1, B+C, D]
+    pre_positions = pre_p0 + jnp.arange(c)
+    positions = jnp.concatenate([pos, pre_positions])[None]
+    dec_mask = (jnp.arange(s_max)[None, None, None, None, :]
+                <= pos[:, None, None, None, None])
+    pre_mask = (jnp.arange(s_max)[None, None, None, None, :]
+                <= pre_positions[None, None, None, :, None])
+    rows = jnp.arange(b)
+    idx = jnp.arange(c)
+    cvalid = (idx < pre_n_valid) & (pre_positions < s_max)
+    lpage_c = jnp.minimum(pre_positions // page_size, p - 1)
+    phys_c = jnp.where(cvalid, tables[pre_slot, lpage_c], 0)
+    off_c = jnp.where(cvalid, pre_positions % page_size, 0)
+    slot_table = jax.lax.dynamic_slice(tables, (pre_slot, 0), (1, p))
+
+    def layer_step(x, inputs):
+        pr, k_cache, v_cache = inputs
+        y = rms_norm(x, pr["attn_norm"])
+        t = b + c
+        q = (y @ pr["wq"].astype(y.dtype)).reshape(1, t, h, hd).transpose(
+            0, 2, 1, 3)
+        k_new = (y @ pr["wk"].astype(y.dtype)).reshape(
+            1, t, hkv, hd).transpose(0, 2, 1, 3)
+        v_new = (y @ pr["wv"].astype(y.dtype)).reshape(
+            1, t, hkv, hd).transpose(0, 2, 1, 3)
+        q = rope(q, positions, cfg.rope_theta)
+        k_new = rope(k_new, positions, cfg.rope_theta)
+        qd = q[0, :, :b].transpose(1, 0, 2)[:, :, None, :]  # [B,h,1,hd]
+        kd = k_new[0, :, :b].transpose(1, 0, 2)             # [B,Hkv,hd]
+        vd = v_new[0, :, :b].transpose(1, 0, 2)
+        qp = q[:, :, b:]                                    # [1,h,C,hd]
+        kp = k_new[0, :, b:].transpose(1, 0, 2)             # [C,Hkv,hd]
+        vp = v_new[0, :, b:].transpose(1, 0, 2)
+        # Writes first, decode rows then the chunk (disjoint pages by
+        # the caller's pre_slot guarantee), so in-chunk causality holds.
+        k_cache, v_cache = _scatter_token_kv(
+            k_cache, v_cache, kd, vd, tables, rows, pos, page_size,
+            s_max)
+        k_cache = k_cache.at[phys_c, :, off_c, :].set(kp)
+        v_cache = v_cache.at[phys_c, :, off_c, :].set(vp)
+        od = _gqa_cache_attention(
+            qd, _gather_pages(k_cache, tables, cfg),
+            _gather_pages(v_cache, tables, cfg), dec_mask, cfg)
+        op = _gqa_cache_attention(
+            qp, _gather_pages(k_cache, slot_table, cfg),
+            _gather_pages(v_cache, slot_table, cfg), pre_mask, cfg)
+        o = jnp.concatenate([od[:, 0][None], op], axis=1)  # [1,B+C,D]
+        x = x + o @ pr["wo"].astype(o.dtype)
+        y = rms_norm(x, pr["ffn_norm"])
+        gate = jax.nn.silu(y @ pr["w_gate"].astype(y.dtype))
+        up = y @ pr["w_up"].astype(y.dtype)
+        x = x + (gate * up) @ pr["w_down"].astype(y.dtype)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["blocks"], cache["k"], cache["v"]))
+    heads_in = jnp.concatenate(
+        [x[0, :b], x[0, b + pre_n_valid - 1][None]], axis=0)  # [B+1, D]
+    logits = _lm_head(heads_in, params, cfg)
+    return logits[:b], logits[b], {"k": new_k, "v": new_v}
+
+
+def copy_pages(cache, src, dst):
+    """Device-side page copy (the COW in copy-on-write): physical pages
+    ``src[i]`` -> ``dst[i]`` across every layer in one program. src/dst
+    [N] int32; jit with the cache donated so the copy is in-place."""
+    return {
+        "k": cache["k"].at[:, dst].set(cache["k"][:, src]),
+        "v": cache["v"].at[:, dst].set(cache["v"][:, src]),
+    }
+
+
 def generate(params, prompt_tokens, cfg: LlamaConfig, max_new: int = 32,
              temperature: float = 0.0, key=None):
     """Greedy/sampled generation (the serve replica's inner loop)."""

@@ -698,10 +698,22 @@ class _AsyncHTTPProxy:
             # — its Router owns a live long-poll listener thread that
             # tracks replica-set changes itself; popping it per failing
             # request would leak one such thread each time.
+            #
+            # Admission sheds (bounded pending queue / queue timeout in
+            # the deployment) surface as a typed OverloadedError; map it
+            # to 503 so clients can distinguish "back off and retry"
+            # from a real failure. The error may arrive re-raised or
+            # wrapped after the actor boundary, so match the type NAME
+            # and the message marker, not the class identity.
+            overloaded = ("OverloadedError" in type(e).__name__
+                          or "overloaded" in str(e).lower())
             try:
+                body = {"error": str(e)}
+                if overloaded:
+                    body["overloaded"] = True
                 self._write_simple(
-                    writer, 500, json.dumps({"error": str(e)}).encode(),
-                    keep)
+                    writer, 503 if overloaded else 500,
+                    json.dumps(body).encode(), keep)
             except Exception:
                 return False
             return True

@@ -66,6 +66,18 @@ def test_bench_smoke_runs_all_stages():
     assert scrape["rt_serve_requests_total"] > 0, scrape
     assert scrape["rt_serve_request_latency_count"] > 0, scrape
 
+    # Paged-KV multi-turn sessions (ISSUE 15): warm turns must hit the
+    # radix prefix cache and beat cold TTFT. The full bench commits the
+    # >= 2x criterion; the smoke gate is deliberately looser (1.5x) so
+    # a loaded CI host can't flake it, while still catching a prefix
+    # cache that stopped caching (speedup ~1x, hit rate 0).
+    assert "llm_sessions_error" not in result, result
+    sess = result["llm_sessions"]
+    assert sess["prefix_hit_rate"] > 0, sess
+    assert sess["ttft_cold_ms_p50"] > 0 and sess["ttft_warm_ms_p50"] > 0
+    assert sess["warm_ttft_speedup"] >= 1.5, sess
+    assert sess["prefix_tokens_saved"] > 0, sess
+
     # Head-failover recovery stage: subprocess heads on a shared WAL —
     # the chaos loop must actually kill and recover, committing latency.
     # (The stage degrades gracefully on toolchain-less hosts, matching

@@ -58,6 +58,54 @@ def test_llm_app_http_and_stream(serve_instance):
     assert [json.loads(ln) for ln in lines] == ref
 
 
+def test_llm_burst_sheds_with_503(serve_instance):
+    """A burst beyond slot + pending capacity must shed with typed 503
+    ("overloaded") responses while admitted requests complete normally
+    — not stall, not 500, not grow the queue without bound."""
+    import threading
+    import urllib.error
+
+    from ray_tpu.llm import build_llm_app
+
+    app = build_llm_app(model="llama-tiny", num_slots=1, chunk=8,
+                        seed=0, name="llmshed", max_pending=1,
+                        queue_timeout_s=30.0)
+    serve_instance.run(app)
+    prompt = [3, 141, 59, 26, 5]
+    ref = _reference(prompt, 8)
+    results = {}
+
+    def call(i):
+        body = json.dumps({"prompt": prompt, "max_tokens": 8}).encode()
+        req = urllib.request.Request(
+            "http://127.0.0.1:18571/llmshed", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                results[i] = ("ok", json.loads(r.read()))
+        except urllib.error.HTTPError as e:
+            results[i] = (e.code, json.loads(e.read()))
+
+    threads = [threading.Thread(target=call, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    shed = [v for v in results.values() if v[0] == 503]
+    ok = [v for v in results.values() if v[0] == "ok"]
+    assert len(results) == 8
+    assert shed, f"burst of 8 into 1 slot + 1 pending never shed: " \
+                 f"{sorted(k for k, _ in results.values())}"
+    assert ok, "every request shed — resident sessions starved"
+    for _, body in shed:
+        assert body.get("overloaded") is True, body
+        assert "overloaded" in body["error"].lower(), body
+    for _, body in ok:
+        assert body["tokens"] == ref
+    assert not any(v[0] == 500 for v in results.values()), results
+
+
 def test_llm_concurrent_http_requests(serve_instance):
     """Several in-flight HTTP generations share the slot pool."""
     import threading

@@ -104,6 +104,45 @@ def test_runtime_metrics_visible_in_cluster_scrape(rt_shared):
         stop_dashboard()
 
 
+def test_llm_prefix_metrics_visible_in_cluster_scrape(rt_shared):
+    """The rt_llm_* family (ISSUE-15): an engine admission that misses
+    then hits the radix prefix cache must show both counter series in
+    the dashboard /metrics scrape, alongside the page gauges and a
+    nonzero TTFT histogram."""
+    import jax
+
+    from ray_tpu.llm.engine import SlotEngine
+    from ray_tpu.models import llama
+    from ray_tpu.observability import start_dashboard, stop_dashboard
+
+    cfg = llama.CONFIGS["llama-tiny"]
+    params, _ = llama.init_params(jax.random.PRNGKey(0), cfg)
+    eng = SlotEngine(params, cfg, num_slots=2, chunk=8, page_size=8)
+    prompt = list(range(1, 20))
+    for _ in range(2):  # first admission misses, second hits
+        h = eng.submit(prompt, max_new=4)
+        while not h._done.is_set():
+            eng.step()
+    assert eng.prefix_hits >= 1 and eng.prefix_misses >= 1
+
+    start_dashboard(port=18365)
+    try:
+        rows = _samples(_scrape(18365))
+    finally:
+        stop_dashboard()
+    prefix = {labels.get("result"): v for name, labels, v in rows
+              if name == "rt_llm_prefix_hit"}
+    assert prefix.get("hit", 0) >= 1, rows[:40]
+    assert prefix.get("miss", 0) >= 1, rows[:40]
+    by_name = {name: v for name, labels, v in rows}
+    assert by_name.get("rt_llm_prefix_tokens_saved", 0) >= 16
+    assert by_name.get("rt_llm_pages_used", -1) >= 1  # scratch at least
+    assert by_name.get("rt_llm_pages_free", -1) >= 0
+    assert by_name["rt_llm_pages_used"] + by_name["rt_llm_pages_free"] \
+        == eng.pages_total
+    assert by_name.get("rt_llm_ttft_seconds_count", 0) >= 2
+
+
 import contextlib
 
 
