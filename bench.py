@@ -990,6 +990,111 @@ def bench_llm_sessions(on_tpu: bool, smoke: bool = False) -> dict:
     return out
 
 
+def bench_flight(on_tpu: bool, smoke: bool = False) -> dict:
+    """Flight-recorder stage (ISSUE 16): exercise both recorder paths
+    and commit their numbers to the bench JSON. Task half — run a spin
+    workload on the live runtime and report the head-side per-stage
+    (queue/sched/exec/transfer) p50/p99 plus the stage-sum/total
+    fraction, which is ~1.0 by construction and asserted by the smoke
+    test. LLM half — drive a paged engine, report per-request stage
+    p50s from the response ``timing`` metadata, and commit the decode
+    roofline fraction (achieved HBM bytes/step over the configured
+    ``hbm_bandwidth_gbps`` peak) so regressions in decode-step
+    bandwidth show up between rounds."""
+    import gc
+
+    import ray_tpu as rt
+    from ray_tpu.observability import flight_summary, recent_flight_tasks
+
+    fast = smoke and os.environ.get("BENCH_SMOKE_FAST") == "1"
+    rt.init(ignore_reinit_error=True, num_cpus=4)
+
+    @rt.remote
+    def _spin(ms):
+        end = time.perf_counter() + ms / 1e3
+        while time.perf_counter() < end:
+            pass
+        return ms
+
+    n_tasks = 16 if fast else 48
+    rt.get([_spin.remote(2) for _ in range(n_tasks)], timeout=120)
+
+    # The exec deltas ride the worker metrics flush (~1s interval);
+    # poll until every spin task's exec stage has joined head-side.
+    out: dict = {"task_n": n_tasks}
+    spin_row = None
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        summ = flight_summary()
+        row = next((v for k, v in summ.items() if "_spin" in k), None)
+        if (row is not None and "exec" in row["stages"]
+                and row["stages"]["exec"]["count"] >= n_tasks):
+            spin_row = row
+            break
+        time.sleep(0.25)
+    if spin_row is None:
+        out["task_join_timeout"] = True
+        spin_row = next((v for k, v in flight_summary().items()
+                         if "_spin" in k), None)
+    if spin_row is not None:
+        for stage, d in spin_row["stages"].items():
+            out[f"task_{stage}_ms_p50"] = d["p50_ms"]
+            out[f"task_{stage}_ms_p99"] = d["p99_ms"]
+    rows = [r for r in recent_flight_tasks(limit=500)
+            if "_spin" in r["name"] and r["total_s"] > 0]
+    out["task_rows_joined"] = len(rows)
+    if rows:
+        fracs = [(r["queue_s"] + r["sched_s"] + r["exec_s"]
+                  + r["transfer_s"]) / r["total_s"] for r in rows]
+        out["task_stage_sum_frac_mean"] = round(
+            sum(fracs) / len(fracs), 4)
+
+    # -- LLM half: per-request stage timing + decode roofline. Engine
+    # lives in THIS process, so its rt_llm_* series land in the local
+    # registry the scrape stage reads.
+    import jax
+    import numpy as np
+
+    from ray_tpu.llm.engine import SlotEngine
+    from ray_tpu.models import llama
+
+    if on_tpu:
+        model, slots, chunk, ps, block = "llama-1b", 8, 128, 16, 16
+        prompt_len, max_new, n_reqs = 256, 64, 16
+    else:
+        model, slots, chunk, ps, block = "llama-tiny", 4, 8, 8, 2
+        prompt_len, max_new = 24, 8
+        n_reqs = 4 if fast else 8
+    cfg = llama.CONFIGS[model]
+    params, _ = llama.init_params(jax.random.PRNGKey(0), cfg)
+    params = jax.tree.map(lambda x: x.astype(cfg.dtype), params)
+    engine = SlotEngine(params, cfg, num_slots=slots, chunk=chunk,
+                        decode_block=block, page_size=ps).start()
+    rng = np.random.default_rng(0)
+    try:
+        engine.warmup()
+        handles = [engine.submit(
+            rng.integers(1, cfg.vocab_size, size=prompt_len).tolist(),
+            max_new=max_new) for _ in range(n_reqs)]
+        timings = [h.result(timeout=300).timing for h in handles]
+        prof = engine.decode_profile()
+    finally:
+        engine.stop()
+    timings = [t for t in timings if t]
+    out["llm_requests"] = len(timings)
+    for key in ("admission_s", "queue_s", "prefix_match_s", "prefill_s",
+                "decode_s", "decode_per_token_s", "total_s"):
+        pct = percentiles([t[key] * 1e3 for t in timings])
+        out[f"llm_{key[:-2]}_ms_p50"] = pct["p50"]
+    out["llm_decode_steps"] = prof["steps"]
+    out["llm_decode_bytes_per_step"] = prof["bytes_per_step"]
+    out["llm_achieved_gbps"] = prof["achieved_gbps"]
+    out["rt_llm_roofline_frac"] = prof["roofline_frac"]
+    del engine, params
+    gc.collect()
+    return out
+
+
 def bench_long_context(on_tpu: bool) -> dict:
     """Long-context training MFU on one chip: GPT-2 355M with flash
     attention at seq 4k/8k/16k, constant 16k tokens per step (VERDICT r4
@@ -1171,6 +1276,10 @@ def scrape_telemetry(port: int = 18269) -> dict:
         "rt_serve_replicas": total("rt_serve_replicas"),
         "rt_serve_request_latency_count": total(
             "rt_serve_request_latency_seconds_count"),
+        "rt_task_stage_seconds_count": total(
+            "rt_task_stage_seconds_count"),
+        "rt_llm_stage_seconds_count": total("rt_llm_stage_seconds_count"),
+        "rt_llm_roofline_frac": total("rt_llm_roofline_frac"),
     }
 
 
@@ -1248,6 +1357,13 @@ def smoke() -> dict:
         result["llm_sessions"] = bench_llm_sessions(False, smoke=True)
     except Exception as e:  # noqa: BLE001
         result["llm_sessions_error"] = repr(e)[:300]
+    # Flight-recorder stage BEFORE the scrape: it sets the roofline
+    # gauge and observes the stage histograms this process's /metrics
+    # must then contain.
+    try:
+        result["bench_flight"] = bench_flight(False, smoke=True)
+    except Exception as e:  # noqa: BLE001
+        result["bench_flight_error"] = repr(e)[:300]
     # Mid-bench scrape while the runtime is still up: the stages above
     # must have left their marks in the cluster /metrics.
     try:

@@ -147,6 +147,17 @@ _define("telemetry_enabled", bool, True,
         "metrics_report_interval_ms (reference: _private/metrics_agent.py "
         "per-node agent -> dashboard aggregation). 0 disables for "
         "overhead A/B runs.")
+_define("flight_recorder_enabled", bool, True,
+        "Per-task flight recorder: stamp lifecycle transitions "
+        "(submitted/scheduled/dispatched/finished) on every task record "
+        "and aggregate per-function per-stage latency on the head "
+        "(reference: gcs_task_manager task events -> `ray summary "
+        "tasks`). No effect when telemetry_enabled is off.")
+_define("hbm_bandwidth_gbps", float, 900.0,
+        "Peak per-chip HBM bandwidth in GB/s used as the roofline "
+        "denominator for rt_llm_roofline_frac (v5e ~819, v5p ~2765, "
+        "v4 ~1228; default ~v4-ish). Set per deployment for honest "
+        "fractions.")
 _define("event_log_max_bytes", int, 64 * 1024**2, "Structured event log cap.")
 _define("debug_dump_period_ms", int, 10_000,
         "Period for debug-state dumps (reference: "

@@ -79,6 +79,10 @@ class _TaskRecord:
     state: str = "PENDING"  # PENDING|RUNNING|DONE|FAILED|CANCELLED
     deps_remaining: int = 0
     resources_released: bool = False
+    # Flight recorder: monotonic stamp per lifecycle transition
+    # (submitted/queued/scheduled/dispatched/finished|failed). None when
+    # the recorder is off — one attribute slot, zero dict cost.
+    state_ts: Optional[Dict[str, float]] = None
 
 
 @dataclass
@@ -162,6 +166,16 @@ class Runtime:
         else:
             self._metrics = None
             self._ctr_submitted = self._ctr_finished = None
+        # Flight recorder (per-task stage stamps -> observability.flight).
+        # The aggregator is module-global: clear it so a runtime that
+        # replaces a dead one in this process (head failover, test
+        # re-init) starts with a clean event store instead of inheriting
+        # the previous head's possibly-torn records.
+        from ..observability import flight as _flight
+
+        self._flight_on = _flight.enabled()
+        if self._flight_on:
+            _flight.clear()
         # Session log dir: workers redirect stdout/stderr there; the log
         # monitor tails the files and republishes to the driver
         # (reference: log_monitor.py + session_latest/logs layout).
@@ -772,11 +786,23 @@ class Runtime:
             key = tuple(sorted(pairs))
             self._finished_keys[(state, node_hex)] = key
         self._ctr_finished.inc_key(key)
+        ts = record.state_ts
+        if ts is not None:
+            ts["finished" if state == "DONE" else "failed"] = \
+                time.monotonic()
+            from ..observability import flight
+
+            spec = record.spec
+            flight.task_finished(
+                spec.task_id.hex(),
+                spec.name or spec.method_name or "fn", ts, state)
 
     def _submit_normal_task(self, spec: TaskSpec) -> List[ObjectRef]:
         if self._ctr_submitted is not None:
             self._ctr_submitted.inc_key(self._key_task)
         record = _TaskRecord(spec, retries_left=spec.max_retries)
+        if self._flight_on:
+            record.state_ts = {"submitted": time.monotonic()}
         return_refs = [ObjectRef(oid) for oid in spec.return_ids()]
         with self._lock:
             self._tasks[spec.task_id] = record
@@ -805,8 +831,11 @@ class Runtime:
         if not records:
             return
         leases = []
+        qnow = time.monotonic() if self._flight_on else 0.0
         with self._lock:
             for record in records:
+                if record.state_ts is not None:
+                    record.state_ts["queued"] = qnow
                 spec = record.spec
                 lease = PendingLease(
                     spec,
@@ -853,6 +882,11 @@ class Runtime:
 
     def _schedule_task(self, record: _TaskRecord) -> None:
         spec = record.spec
+        if self._flight_on:
+            # Fresh stamps per attempt: a retry's queue/exec intervals
+            # must not be measured against the failed attempt's clock.
+            record.state_ts = {"submitted": time.monotonic(),
+                               "queued": time.monotonic()}
         lease = PendingLease(
             spec,
             on_granted=lambda node, worker: self._dispatch(record, node, worker),
@@ -889,6 +923,8 @@ class Runtime:
     def _dispatch(self, record: _TaskRecord, node: NodeManager,
                   worker: WorkerHandle) -> None:
         spec = record.spec
+        if record.state_ts is not None:
+            record.state_ts["scheduled"] = time.monotonic()
         resolved: Dict[int, Any] = {}
         failed_error = None
         lost_arg = None
@@ -933,6 +969,8 @@ class Runtime:
             "runtime_env": spec.runtime_env,
             "trace_ctx": spec.trace_ctx,
         }))
+        if record.state_ts is not None:
+            record.state_ts["dispatched"] = time.monotonic()
         if not ok:
             self._handle_worker_death(worker)
 
@@ -1269,6 +1307,9 @@ class Runtime:
     def _schedule_actor_creation(self, record: _ActorRecord) -> None:
         spec = record.creation_spec
         task_record = _TaskRecord(spec, retries_left=0)
+        if self._flight_on:
+            now = time.monotonic()
+            task_record.state_ts = {"submitted": now, "queued": now}
         with self._lock:
             self._tasks[spec.task_id] = task_record
 
@@ -1406,6 +1447,12 @@ class Runtime:
             task_record = _TaskRecord(spec, retries_left=spec.max_retries,
                                       node=record.node, worker=worker,
                                       state="RUNNING")
+            if self._flight_on:
+                # Actor pushes skip the scheduler: submit == scheduled
+                # (queue/sched stages are genuinely ~0 on this path).
+                now = time.monotonic()
+                task_record.state_ts = {"submitted": now, "queued": now,
+                                        "scheduled": now}
             self._tasks[spec.task_id] = task_record
             self._worker_tasks.setdefault(
                 worker.worker_id.binary(), set()).add(spec.task_id)
@@ -1428,6 +1475,8 @@ class Runtime:
         ok = worker.send(("aexec", spec.task_id.hex(), spec.actor_id.hex(),
                           spec.method_name, spec.args_frame, resolved,
                           spec.num_returns, spec.trace_ctx))
+        if task_record.state_ts is not None:
+            task_record.state_ts["dispatched"] = time.monotonic()
         if not ok:
             self._handle_worker_death(worker)
 

@@ -501,6 +501,8 @@ class WorkerRuntime:
                 restore_runtime_env(env_undo)
             if self._task_latency is not None:
                 self._task_latency.observe(time.perf_counter() - exec_start)
+                self._telemetry_exporter.record_flight(
+                    task_id_hex, time.perf_counter() - exec_start)
             self.current_task_id = prev_task
 
     def _start_actor_loop(self):
@@ -643,6 +645,8 @@ class WorkerRuntime:
         finally:
             if self._task_latency is not None:
                 self._task_latency.observe(time.perf_counter() - exec_start)
+                self._telemetry_exporter.record_flight(
+                    task_id_hex, time.perf_counter() - exec_start)
             self.current_task_id = prev_task
 
     def _destroy_actor(self, actor_hex: str) -> None:

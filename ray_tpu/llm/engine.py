@@ -51,6 +51,11 @@ import numpy as np
 from ..models import llama
 from .paged import OverloadedError, PagePool, RadixIndex, llm_metrics
 
+# Interned tag keys for the per-stage histogram (request finish path).
+_LLM_STAGE_KEYS = {s: (("stage", s),) for s in
+                   ("admission", "queue", "prefix_match", "prefill",
+                    "decode")}
+
 
 def _sample(logits, temps, seeds, qpos):
     """Greedy when temp == 0, else temperature sampling with a
@@ -73,6 +78,10 @@ class GenerationResult:
     tokens: List[int]
     prompt_len: int
     finish_reason: str  # "stop" (eos) | "length"
+    # Flight-recorder stage breakdown (seconds): admission_s, queue_s,
+    # prefix_match_s, prefill_s, decode_s, decode_per_token_s, total_s,
+    # matched_tokens. None when the request errored before finishing.
+    timing: Optional[dict] = None
 
 
 class RequestHandle:
@@ -91,6 +100,7 @@ class RequestHandle:
         self._done = threading.Event()
         self._finish_reason = "length"
         self.error: Optional[BaseException] = None
+        self.timing: Optional[dict] = None  # set by the engine at finish
 
     def __iter__(self):
         while True:
@@ -108,7 +118,8 @@ class RequestHandle:
             raise self.error
         return GenerationResult(tokens=list(self._tokens),
                                 prompt_len=self._prompt_len,
-                                finish_reason=self._finish_reason)
+                                finish_reason=self._finish_reason,
+                                timing=self.timing)
 
     # engine-side
     def _emit(self, tok: int) -> None:
@@ -133,6 +144,13 @@ class _Slot:
     on_token: Optional[Callable[[Optional[int]], None]]
     seed: int = 0  # per-request sampling stream
     submit_t: float = 0.0  # monotonic submit time (TTFT + queue timeout)
+    # Flight-recorder stamps (monotonic) + measured prefix-match cost:
+    # submit -> admit (queue wait) -> first prefill dispatch -> first
+    # token -> finish decomposes the request's end-to-end latency.
+    admit_t: float = 0.0
+    prefill_start_t: float = 0.0
+    first_tok_t: float = 0.0
+    prefix_match_s: float = 0.0
     prefill_offset: int = 0  # next chunk start; == len(prompt) when done
     matched_len: int = 0  # prompt tokens whose prefill the radix skipped
     pos: int = 0  # write position of the NEXT decode step
@@ -272,6 +290,21 @@ class SlotEngine:
         # warmup() would race a running engine thread's dispatches.
         zero = jnp.zeros((1,), jnp.int32)
         self._cache = self._copy_pages(self._cache, zero, zero)
+        # Decode-step roofline profiler (flight recorder, LLM path): a
+        # decode step is memory-bound — it must stream the params plus
+        # every resident KV page through HBM once. Model footprint is
+        # measured from the actual pytrees; achieved bytes/s over the
+        # configured peak bandwidth is rt_llm_roofline_frac.
+        self._param_bytes = sum(
+            x.size * x.dtype.itemsize
+            for x in jax.tree_util.tree_leaves(self._params))
+        cache_bytes = sum(x.size * x.dtype.itemsize
+                          for x in jax.tree_util.tree_leaves(self._cache))
+        self._kv_page_bytes = cache_bytes // max(1, self._num_pages)
+        self._prof_steps = 0
+        self._prof_wall = 0.0
+        self._prof_bytes = 0.0
+        self._prof_t0: Optional[float] = None
         # lag-1 decode pipeline state
         self._inflight = None  # (snapshot, pre_info, toks_k, pre_tok)
         self._last_dev = jnp.zeros((num_slots,), jnp.int32)
@@ -464,7 +497,9 @@ class SlotEngine:
         full_pages: List[int] = []
         partial = None
         if self._radix is not None:
+            match_t0 = time.monotonic()
             full_pages, partial = self._radix.match(s.prompt)
+            s.prefix_match_s = time.monotonic() - match_t0
             # The engine needs the LAST prompt token's logits to sample
             # the first output, so at least one prompt token must
             # prefill: cap the match at len(prompt) - 1.
@@ -536,6 +571,7 @@ class SlotEngine:
             if hit:
                 m["prefix_tokens"].inc(s.matched_len)
         self._publish_page_gauges()
+        s.admit_t = time.monotonic()
         self._slots[idx] = s
         return True
 
@@ -558,14 +594,32 @@ class SlotEngine:
                       if s is not None and s.prefill_done
                       and not s.first_tok_pending]
         ran = False
+        had_fetch = self._inflight is not None
         new_block = (self._dispatch_block(active, prefill_idx)
                      if (active or prefill_idx is not None) else None)
-        if self._inflight is not None:
+        if had_fetch:
             self._process_fetch()
             ran = True
         if new_block is not None:
             self._inflight = new_block
             ran = True
+        # Roofline accounting: only steady pipeline intervals count —
+        # a step that both dispatched a block with active decode slots
+        # AND fetched the previous one spans exactly decode_block
+        # device steps; anything else (admission-only, pipeline fill or
+        # drain, idle) would pollute the bytes/s estimate.
+        if new_block is not None and had_fetch and active:
+            now = time.monotonic()
+            if self._prof_t0 is not None:
+                steps = self.decode_block
+                self._prof_wall += now - self._prof_t0
+                self._prof_steps += steps
+                self._prof_bytes += steps * (
+                    self._param_bytes
+                    + self._pool.used_count * self._kv_page_bytes)
+            self._prof_t0 = now
+        else:
+            self._prof_t0 = None
         return ran
 
     def _dispatch_block(self, active, prefill_idx):
@@ -608,6 +662,8 @@ class SlotEngine:
         # program's first step.
         pre_buf = np.zeros((self.chunk,), dtype=np.int32)
         s = self._slots[prefill_idx]
+        if s.prefill_start_t == 0.0:
+            s.prefill_start_t = time.monotonic()
         p0 = s.prefill_offset
         piece = s.prompt[p0:p0 + self.chunk]
         n_valid = len(piece)
@@ -662,20 +718,88 @@ class SlotEngine:
                 s.on_device_chain = False
                 self._deliver(idx, s, int(pre_tok))
 
+    def _request_timing(self, s: _Slot) -> dict:
+        """Stage decomposition of one finished request. admission =
+        waiting in the pending FIFO for a slot + pages; queue = admitted
+        but not yet in the prefill lane; prefill = first chunk dispatch
+        to first token; decode = the rest. Sums to ~total by
+        construction (clamps only absorb clock jitter)."""
+        end = time.monotonic()
+        admit = s.admit_t or s.submit_t
+        pre0 = s.prefill_start_t or admit
+        first = s.first_tok_t or end
+        timing = {
+            "admission_s": max(0.0, admit - s.submit_t),
+            "queue_s": max(0.0, pre0 - admit),
+            "prefix_match_s": s.prefix_match_s,
+            "prefill_s": max(0.0, first - pre0),
+            "decode_s": max(0.0, end - first),
+            "decode_per_token_s": (max(0.0, end - first)
+                                   / max(1, s.produced - 1)),
+            "total_s": max(0.0, end - s.submit_t),
+            "matched_tokens": s.matched_len,
+            "produced_tokens": s.produced,
+        }
+        m = llm_metrics()
+        if m is not None:
+            st = m["stage"]
+            st.observe_key(_LLM_STAGE_KEYS["admission"],
+                           timing["admission_s"])
+            st.observe_key(_LLM_STAGE_KEYS["queue"], timing["queue_s"])
+            st.observe_key(_LLM_STAGE_KEYS["prefix_match"],
+                           timing["prefix_match_s"])
+            st.observe_key(_LLM_STAGE_KEYS["prefill"],
+                           timing["prefill_s"])
+            st.observe_key(_LLM_STAGE_KEYS["decode"], timing["decode_s"])
+            m["decode_per_token"].observe(timing["decode_per_token_s"])
+        return timing
+
+    def decode_profile(self) -> dict:
+        """Achieved-vs-peak HBM accounting for the decode loop
+        (ROADMAP item 2's ``roofline_frac``). Publishes the
+        ``rt_llm_roofline_frac`` gauge as a side effect."""
+        from ..core.config import config
+
+        steps, wall = self._prof_steps, self._prof_wall
+        hbm_gbps = float(config().hbm_bandwidth_gbps)
+        if steps == 0 or wall <= 0.0:
+            prof = {"steps": 0, "wall_s": 0.0, "avg_step_ms": 0.0,
+                    "steps_per_s": 0.0, "bytes_per_step": 0,
+                    "achieved_gbps": 0.0, "hbm_gbps": hbm_gbps,
+                    "roofline_frac": 0.0}
+        else:
+            achieved_gbps = self._prof_bytes / wall / 1e9
+            prof = {
+                "steps": steps,
+                "wall_s": round(wall, 6),
+                "avg_step_ms": round(wall / steps * 1e3, 4),
+                "steps_per_s": round(steps / wall, 2),
+                "bytes_per_step": int(self._prof_bytes / steps),
+                "achieved_gbps": round(achieved_gbps, 4),
+                "hbm_gbps": hbm_gbps,
+                "roofline_frac": achieved_gbps / hbm_gbps,
+            }
+        m = llm_metrics()
+        if m is not None:
+            m["roofline_frac"].set(prof["roofline_frac"])
+        return prof
+
     def _deliver(self, idx: int, s: _Slot, tok: int) -> None:
         s.last_token = tok
         s.produced += 1
         self.tokens_generated += 1
         if s.produced == 1:
+            s.first_tok_t = time.monotonic()
             m = llm_metrics()
             if m is not None:
-                m["ttft"].observe(time.monotonic() - s.submit_t)
+                m["ttft"].observe(s.first_tok_t - s.submit_t)
         s.handle._emit(tok)
         if s.on_token:
             s.on_token(tok)
         hit_eos = s.eos_id is not None and tok == s.eos_id
         out_of_room = (len(s.prompt) + s.produced) >= self.cfg.max_seq
         if hit_eos or s.produced >= s.max_new or out_of_room:
+            s.handle.timing = self._request_timing(s)
             s.handle._finish("stop" if hit_eos else "length")
             if s.on_token:
                 s.on_token(None)

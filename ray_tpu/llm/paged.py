@@ -258,5 +258,24 @@ def llm_metrics() -> Optional[Dict[str, Any]]:
                     "Submit-to-first-token latency",
                     boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
                                 1.0, 5.0, 30.0]),
+                # Per-request stage breakdown (flight recorder, LLM
+                # path): admission wait + queue wait + prefix match +
+                # prefill + per-token decode sum to roughly the
+                # end-to-end request latency.
+                "stage": get_or_create(
+                    Histogram, "rt_llm_stage_seconds",
+                    "LLM request latency attributed per stage",
+                    boundaries=[0.0001, 0.001, 0.01, 0.1, 1.0, 10.0,
+                                60.0],
+                    tag_keys=("stage",)),
+                "decode_per_token": get_or_create(
+                    Histogram, "rt_llm_decode_per_token_seconds",
+                    "Mean inter-token decode latency per request",
+                    boundaries=[0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                                0.5, 1.0]),
+                "roofline_frac": get_or_create(
+                    Gauge, "rt_llm_roofline_frac",
+                    "Achieved decode HBM bytes/s over the configured "
+                    "peak bandwidth (hbm_bandwidth_gbps)"),
             }
         return _llm_metrics_cache

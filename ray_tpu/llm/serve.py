@@ -8,7 +8,9 @@ path. Request schema (POST body JSON):
     {"prompt": [token ids...], "max_tokens": 64, "temperature": 0.0,
      "eos_id": null, "stream": false}
 
-Responses: ``{"tokens": [...], "finish_reason": ..., "prompt_len": N}``
+Responses: ``{"tokens": [...], "finish_reason": ..., "prompt_len": N,
+"timing": {...}}`` — ``timing`` is the flight recorder's per-request
+stage breakdown (admission/queue/prefix_match/prefill/decode seconds) —
 or, with ``stream: true``, one JSON token-id per chunk line.
 
 Reference analog: ``/root/reference/python/ray/serve/_private/replica.py``
@@ -121,8 +123,11 @@ class LLMServer:
         if handle.error is not None:
             raise handle.error
         res = handle.result(timeout=0)
+        # "timing": the flight recorder's per-request stage breakdown
+        # (admission/queue/prefix_match/prefill/decode seconds) — every
+        # response carries its own latency attribution.
         return {"tokens": res.tokens, "finish_reason": res.finish_reason,
-                "prompt_len": res.prompt_len}
+                "prompt_len": res.prompt_len, "timing": res.timing}
 
     def stats(self) -> dict:
         return {
@@ -135,6 +140,7 @@ class LLMServer:
             "prefix_tokens_saved": self.engine.prefix_tokens_saved,
             "pages_used": self.engine.pages_used,
             "pages_free": self.engine.pages_free,
+            "decode_profile": self.engine.decode_profile(),
         }
 
 

@@ -6,6 +6,11 @@ Reference analog: ``ray.util.metrics``, ``ray.experimental.state.api``,
 
 from .dashboard import Dashboard, start_dashboard, stop_dashboard
 from .events import EventLog, Severity, emit, global_event_log
+from .flight import (
+    flight_summary,
+    format_flight_summary,
+    recent_flight_tasks,
+)
 from .metrics import Counter, Gauge, Histogram, core_metrics, registry
 from .event_stats import EventStats, global_event_stats
 from .telemetry import TelemetryExporter, refresh_cluster_gauges
@@ -28,6 +33,7 @@ __all__ = [
     "Counter", "Dashboard", "EventLog", "EventStats", "Gauge",
     "Histogram", "Severity", "actor_detail",
     "cluster_status", "core_metrics", "emit", "event_loop_stats",
+    "flight_summary", "format_flight_summary", "recent_flight_tasks",
     "global_event_log", "global_event_stats",
     "list_actors", "list_nodes", "list_objects", "list_placement_groups",
     "list_tasks", "list_workers", "record_span", "refresh_cluster_gauges",

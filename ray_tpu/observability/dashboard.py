@@ -16,6 +16,7 @@ import json
 import threading
 from typing import Optional
 
+from . import flight
 from . import state as state_api
 from . import telemetry
 from .events import global_event_log
@@ -140,13 +141,17 @@ async function renderOverview(){
   const [summary, stats, nodes] = await Promise.all([
     fetchJson("/api/summary"), fetchJson("/api/node_stats"),
     fetchJson("/api/nodes")]);
-  const total = Object.values(summary).reduce((a,b)=>a+b,0);
-  hist.running.push(summary.RUNNING||0); hist.total.push(total);
+  const states = summary.states || {};
+  const total = Object.values(states).reduce((a,b)=>a+b,0);
+  hist.running.push(states.RUNNING||0); hist.total.push(total);
   hist.load.push(stats.loadavg_1m||0);
   hist.mem.push(stats.mem_used_frac||0);
   for(const k in hist) if(hist[k].length>120) hist[k].shift();
+  const flightRows = Object.entries(summary.flight||{}).flatMap(
+    ([fn,d])=>Object.entries(d.stages).map(([stage,s])=>(
+      {fn, stage, count:s.count, p50_ms:s.p50_ms, p99_ms:s.p99_ms})));
   const cards = [["nodes", nodes.length], ["tasks total", total],
-    ["running", summary.RUNNING||0], ["done", summary.DONE||0],
+    ["running", states.RUNNING||0], ["done", states.DONE||0],
     ["load 1m", (stats.loadavg_1m??0).toFixed(2)],
     ["mem used", ((stats.mem_used_frac??0)*100).toFixed(1)+"%"]]
     .map(([k,v])=>`<div class="card"><div class="v">${esc(v)}</div>
@@ -155,6 +160,7 @@ async function renderOverview(){
     <h2>running tasks</h2>${spark(hist.running)}
     <h2>host load (1m)</h2>${spark(hist.load, 220, 44, "#d4824a")}
     <h2>memory used fraction</h2>${spark(hist.mem, 220, 44, "#7a4ad4")}
+    <h2>task stage latency (flight recorder)</h2>${table(flightRows)}
     <h2>nodes</h2>${table(nodes)}`;
 }
 async function renderTab(tab){
@@ -220,7 +226,10 @@ class Dashboard:
             "/api/objects": state_api.list_objects,
             "/api/workers": state_api.list_workers,
             "/api/placement_groups": state_api.list_placement_groups,
-            "/api/summary": state_api.summarize_tasks,
+            # states: FSM counts; flight: per-function per-stage p50/p99
+            # from the flight recorder (queue/sched/exec/transfer).
+            "/api/summary": lambda: {"states": state_api.summarize_tasks(),
+                                     "flight": flight.summary()},
             "/api/events": lambda: global_event_log().query(limit=200),
             "/api/node_stats": node_stats,
             "/api/jobs": state_api.list_jobs,

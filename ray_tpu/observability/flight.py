@@ -1,0 +1,266 @@
+"""Per-request flight recorder: stage-attributed task latency.
+
+Reference analog: the task-events backend
+(``src/ray/gcs/gcs_server/gcs_task_manager`` + the task-event protos),
+surfaced to users as ``ray summary tasks`` — every task records
+timestamps at each lifecycle transition and the head aggregates them
+into per-function, per-stage latency distributions.
+
+Division of labor:
+
+- The HEAD stamps transitions it observes directly onto each
+  ``_TaskRecord.state_ts`` (``submitted`` / ``scheduled`` /
+  ``dispatched`` / ``finished``|``failed``) with ``time.monotonic()``
+  — all of those happen in the head process, so one clock orders them.
+- WORKERS measure the one interval the head cannot see (execution wall
+  time inside the worker) and ship it as a compact
+  ``(task_id_hex, exec_s)`` delta through the existing PR-13 telemetry
+  channel (``TelemetryExporter.record_flight`` →
+  ``payload["flight"]`` → :func:`ingest`). Durations, not timestamps:
+  monotonic clocks are not comparable across processes.
+- This module joins the two halves per task id and folds the result
+  into bounded per-(function, stage) reservoirs from which
+  :func:`summary` computes p50/p99.
+
+Stage decomposition (sums to the end-to-end latency by construction):
+
+    queue     submitted -> scheduled   (deps + scheduler wait)
+    sched     scheduled -> dispatched  (arg resolution + pipe send)
+    exec      worker-measured execution wall time
+    transfer  (finished - dispatched) - exec  (pipe transit both ways
+              + result store/registration; clamped at 0)
+
+Everything is bounded and gated on ``flight_recorder_enabled`` (itself
+dependent on the telemetry plane); a replacement head after failover
+starts with a clean store (``clear()`` runs in ``Runtime.__init__``).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+# Completed tasks waiting for their worker exec delta (ships on the next
+# telemetry flush, up to metrics_report_interval_ms later) — bounded so
+# a worker that never reports (telemetry disabled mid-flight, crash)
+# cannot grow the head.
+_JOIN_MAX = 50_000
+# Per-(function, stage) duration reservoir: enough samples for stable
+# p99 estimates without unbounded growth.
+_SAMPLES_MAX = 2_048
+# Distinct function names tracked (runaway dynamic-name backstop).
+_FUNCS_MAX = 1_024
+# Recently completed tasks with their full stage breakdown (drill-down
+# + tests); bounded like everything else.
+_RECENT_MAX = 512
+
+_STAGES = ("queue", "sched", "exec", "transfer", "total")
+
+_lock = threading.Lock()
+# task_id_hex -> (name, head-side durations dict)  [awaiting exec join]
+_joins: "OrderedDict[str, tuple]" = OrderedDict()
+# exec deltas that arrived before their head-side record (re-init races)
+_early_exec: "OrderedDict[str, float]" = OrderedDict()
+# name -> stage -> deque[float seconds]
+_stats: Dict[str, Dict[str, deque]] = {}
+_recent: deque = deque(maxlen=_RECENT_MAX)
+_stage_hist = None  # rt_task_stage_seconds, created lazily
+
+
+def enabled() -> bool:
+    from ..core.config import config
+
+    cfg = config()
+    return cfg.telemetry_enabled and cfg.flight_recorder_enabled
+
+
+def _hist():
+    """``rt_task_stage_seconds{stage}`` — the cluster-visible histogram
+    form of the per-stage distributions (autoscaling/alerting signal)."""
+    global _stage_hist
+    if _stage_hist is None:
+        from .metrics import Histogram, get_or_create
+
+        _stage_hist = get_or_create(
+            Histogram, "rt_task_stage_seconds",
+            "Task latency attributed per lifecycle stage",
+            boundaries=[0.0001, 0.001, 0.01, 0.1, 1, 10, 100],
+            tag_keys=("stage",))
+    return _stage_hist
+
+
+# Interned histogram tag keys — commits run on the task completion path.
+_STAGE_KEYS = {s: (("stage", s),) for s in _STAGES}
+
+
+def _commit_locked(name: str, stage: str, seconds: float) -> None:
+    per_fn = _stats.get(name)
+    if per_fn is None:
+        if len(_stats) >= _FUNCS_MAX:
+            from . import telemetry
+
+            telemetry.count_dropped("flight_funcs")
+            return
+        per_fn = _stats[name] = {s: deque(maxlen=_SAMPLES_MAX)
+                                 for s in _STAGES}
+    per_fn[stage].append(seconds)
+
+
+def task_finished(task_id_hex: str, name: str,
+                  state_ts: Dict[str, float], state: str) -> None:
+    """Head side, called once per task reaching DONE/FAILED: fold the
+    head-observed stages in now; park the record until the worker's
+    exec delta arrives to attribute the dispatched->finished interval."""
+    sub = state_ts.get("submitted")
+    end = state_ts.get("finished") or state_ts.get("failed")
+    if sub is None or end is None:
+        return
+    sched = state_ts.get("scheduled", sub)
+    disp = state_ts.get("dispatched", sched)
+    queue_s = max(0.0, sched - sub)
+    sched_s = max(0.0, disp - sched)
+    total_s = max(0.0, end - sub)
+    hist = _hist()
+    with _lock:
+        _commit_locked(name, "queue", queue_s)
+        _commit_locked(name, "sched", sched_s)
+        _commit_locked(name, "total", total_s)
+        exec_s = _early_exec.pop(task_id_hex, None) \
+            if task_id_hex else None
+        if exec_s is None and task_id_hex and state == "DONE":
+            while len(_joins) >= _JOIN_MAX:
+                _joins.popitem(last=False)
+                from . import telemetry
+
+                telemetry.count_dropped("flight_joins")
+            _joins[task_id_hex] = (name, disp, end, queue_s, sched_s,
+                                   total_s)
+    hist.observe_key(_STAGE_KEYS["queue"], queue_s)
+    hist.observe_key(_STAGE_KEYS["sched"], sched_s)
+    hist.observe_key(_STAGE_KEYS["total"], total_s)
+    if exec_s is not None:
+        _join(task_id_hex, name, disp, end, queue_s, sched_s, total_s,
+              exec_s)
+
+
+def _join(task_id_hex: str, name: str, disp: float, end: float,
+          queue_s: float, sched_s: float, total_s: float,
+          exec_s: float) -> None:
+    exec_s = min(max(0.0, exec_s), max(0.0, end - disp))
+    transfer_s = max(0.0, (end - disp) - exec_s)
+    hist = _hist()
+    with _lock:
+        _commit_locked(name, "exec", exec_s)
+        _commit_locked(name, "transfer", transfer_s)
+        _recent.append({
+            "task_id": task_id_hex, "name": name,
+            "queue_s": queue_s, "sched_s": sched_s, "exec_s": exec_s,
+            "transfer_s": transfer_s, "total_s": total_s,
+        })
+    hist.observe_key(_STAGE_KEYS["exec"], exec_s)
+    hist.observe_key(_STAGE_KEYS["transfer"], transfer_s)
+
+
+def ingest(events: List[tuple]) -> None:
+    """Absorb worker-shipped ``(task_id_hex, exec_s)`` flight deltas
+    (called from ``telemetry.absorb`` on the head)."""
+    for item in events:
+        try:
+            task_id_hex, exec_s = item[0], float(item[1])
+        except (TypeError, ValueError, IndexError):
+            continue
+        with _lock:
+            parked = _joins.pop(task_id_hex, None)
+            if parked is None:
+                # Done message not processed yet (or task failed before
+                # completing): park the delta briefly instead.
+                while len(_early_exec) >= _JOIN_MAX:
+                    _early_exec.popitem(last=False)
+                _early_exec[task_id_hex] = exec_s
+                continue
+        name, disp, end, queue_s, sched_s, total_s = parked
+        _join(task_id_hex, name, disp, end, queue_s, sched_s, total_s,
+              exec_s)
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def summary() -> Dict[str, Any]:
+    """Per-function, per-stage latency aggregates:
+    ``{name: {count, stages: {stage: {count, mean_ms, p50_ms,
+    p99_ms}}}}`` — the ``rt summary tasks`` / ``/api/summary`` body."""
+    with _lock:
+        snap = {name: {stage: list(vals) for stage, vals in per_fn.items()}
+                for name, per_fn in _stats.items()}
+    out: Dict[str, Any] = {}
+    for name, per_fn in snap.items():
+        stages = {}
+        for stage, vals in per_fn.items():
+            if not vals:
+                continue
+            vals.sort()
+            stages[stage] = {
+                "count": len(vals),
+                "mean_ms": round(sum(vals) / len(vals) * 1e3, 3),
+                "p50_ms": round(_pct(vals, 0.5) * 1e3, 3),
+                "p99_ms": round(_pct(vals, 0.99) * 1e3, 3),
+            }
+        if stages:
+            out[name] = {"count": stages["total"]["count"]
+                         if "total" in stages else
+                         max(s["count"] for s in stages.values()),
+                         "stages": stages}
+    return out
+
+
+def recent_tasks(limit: int = 100) -> List[Dict[str, Any]]:
+    """Most recently completed tasks with their full stage breakdown
+    (exec-joined only); newest last."""
+    with _lock:
+        rows = list(_recent)
+    return rows[-limit:]
+
+
+def format_summary(data: Optional[Dict[str, Any]] = None) -> str:
+    """Render :func:`summary` as the ``rt summary tasks`` table."""
+    data = summary() if data is None else data
+    if not data:
+        return "(no completed tasks recorded)"
+    header = (f"{'function':<32} {'stage':<9} {'count':>6} "
+              f"{'p50_ms':>9} {'p99_ms':>9} {'mean_ms':>9}")
+    lines = [header, "-" * len(header)]
+    for name in sorted(data):
+        stages = data[name]["stages"]
+        for stage in _STAGES:
+            row = stages.get(stage)
+            if row is None:
+                continue
+            lines.append(
+                f"{name[:32]:<32} {stage:<9} {row['count']:>6} "
+                f"{row['p50_ms']:>9.3f} {row['p99_ms']:>9.3f} "
+                f"{row['mean_ms']:>9.3f}")
+    return "\n".join(lines)
+
+
+def clear() -> None:
+    """Drop every recorded event (test isolation; and a replacement
+    head after failover must start with a clean store, never inherit a
+    possibly-torn aggregator from the process's previous runtime)."""
+    with _lock:
+        _joins.clear()
+        _early_exec.clear()
+        _stats.clear()
+        _recent.clear()
+
+
+# Package-export spellings (the short names collide with the state API's
+# generic vocabulary at the ``ray_tpu.observability`` level).
+flight_summary = summary
+format_flight_summary = format_summary
+recent_flight_tasks = recent_tasks

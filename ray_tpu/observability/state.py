@@ -38,8 +38,41 @@ def list_nodes() -> List[Dict[str, Any]]:
     return out
 
 
+def _filter_get(row: Dict[str, Any], path: str) -> Any:
+    """Resolve a (possibly dotted) filter key against a row:
+    ``resources.CPU`` walks nested dicts; a plain key is a direct get."""
+    cur: Any = row
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+def _matches(row: Dict[str, Any],
+             filters: Optional[Dict[str, str]]) -> bool:
+    if not filters:
+        return True
+    return all(str(_filter_get(row, k)) == str(v)
+               for k, v in filters.items())
+
+
+def _copy_ts(ts: Optional[Dict[str, float]]) -> Optional[Dict[str, float]]:
+    if ts is None:
+        return None
+    try:
+        return dict(ts)
+    except RuntimeError:  # stamp landed mid-copy; second pass settles
+        return dict(ts)
+
+
 def list_tasks(filters: Optional[Dict[str, str]] = None,
                limit: int = 1000) -> List[Dict[str, Any]]:
+    """Task table rows. ``filters`` match on equality, including nested
+    fields via dotted paths (``--filter resources.CPU=1.0``,
+    ``state_ts.dispatched=None``); ``rt list tasks --state RUNNING`` is
+    the CLI spelling of ``filters={"state": "RUNNING"}``. ``state_ts``
+    carries the flight recorder's per-transition monotonic stamps."""
     rt = _head()
     out = []
     with rt._lock:
@@ -54,9 +87,9 @@ def list_tasks(filters: Optional[Dict[str, str]] = None,
             "node_id": rec.node.node_id.hex() if rec.node else None,
             "actor_id": (rec.spec.actor_id.hex()
                          if getattr(rec.spec, "actor_id", None) else None),
+            "state_ts": _copy_ts(rec.state_ts),
         }
-        if filters and any(str(row.get(k)) != str(v)
-                           for k, v in filters.items()):
+        if not _matches(row, filters):
             continue
         out.append(row)
     return out
@@ -347,6 +380,10 @@ _events_lock = _threading.Lock()
 def record_span(name: str, category: str, start_s: float, end_s: float,
                 pid: int = 0, tid: int = 0, args: Optional[dict] = None):
     with _events_lock:
+        if len(_events) >= _EVENTS_MAX:
+            from . import telemetry
+
+            telemetry.count_dropped("timeline")
         _events.append({
             "name": name, "cat": category, "ph": "X",
             "ts": start_s * 1e6, "dur": (end_s - start_s) * 1e6,

@@ -30,6 +30,7 @@ A/B runs (see BASELINE.md "Telemetry overhead").
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from collections import deque
@@ -62,6 +63,38 @@ _PROC_NAMES_MAX = 4096
 _proc_names: Dict[int, str] = {}
 _absorb_lock = threading.Lock()
 
+# Flight-recorder exec deltas buffered per exporter between flushes.
+_FLIGHT_BUF_MAX = 8_192
+
+_logger = logging.getLogger(__name__)
+_dropped_counter = None
+_warned_buffers: set = set()
+_dropped_lock = threading.Lock()
+
+
+def count_dropped(buffer: str, n: int = 1) -> None:
+    """Every bounded telemetry buffer drops SILENTLY when full — which
+    makes a truncated trace indistinguishable from a quiet cluster.
+    Count each drop in ``rt_telemetry_dropped_total{buffer}`` and log
+    one warning per buffer per process so truncation is detectable."""
+    global _dropped_counter
+    with _dropped_lock:
+        if _dropped_counter is None:
+            _dropped_counter = get_or_create(
+                Counter, "rt_telemetry_dropped_total",
+                "Telemetry events dropped by full bounded buffers",
+                ("buffer",))
+    _dropped_counter.inc_key((("buffer", buffer),), float(n))
+    if buffer not in _warned_buffers:
+        with _dropped_lock:
+            if buffer in _warned_buffers:
+                return
+            _warned_buffers.add(buffer)
+        _logger.warning(
+            "telemetry buffer %r full: dropping events (counted in "
+            "rt_telemetry_dropped_total; this warns once per process)",
+            buffer)
+
 
 class TelemetryExporter:
     """Per-process delta snapshotter (worker / daemon side)."""
@@ -79,8 +112,20 @@ class TelemetryExporter:
         # an unsynchronized read-modify-write of _last would ship the
         # same delta twice and double-count on the head.
         self._collect_lock = threading.Lock()
+        # Flight-recorder exec durations, drained into payload["flight"]
+        # each flush. deque(maxlen) drops oldest silently, so overflow
+        # is counted explicitly before append.
+        self._flight: deque = deque(maxlen=_FLIGHT_BUF_MAX)
         # Spans recorded from here on are kept for export too.
         get_tracer().export_enabled = True
+
+    def record_flight(self, task_id_hex: str, exec_s: float) -> None:
+        """Buffer one task's measured execution wall time (a DURATION —
+        monotonic timestamps don't compare across processes) for the
+        head's flight recorder to join with its own stage stamps."""
+        if len(self._flight) >= _FLIGHT_BUF_MAX:
+            count_dropped("flight_exporter")
+        self._flight.append((task_id_hex, exec_s))
 
     def collect(self) -> Optional[dict]:
         """One flush: metric deltas + newly finished spans, or None when
@@ -124,13 +169,19 @@ class TelemetryExporter:
         spans = [span_chrome_event(s, self.pid)
                  for s in get_tracer().drain_export()
                  if s.end_s is not None]
-        if not metrics_out and not spans:
+        flight = []
+        while self._flight:
+            flight.append(self._flight.popleft())
+        if not metrics_out and not spans and not flight:
             return None
-        return {
+        payload = {
             "node": self.node, "worker": self.worker,
             "pid": self.pid, "proc": self.proc,
             "metrics": metrics_out, "spans": spans,
         }
+        if flight:
+            payload["flight"] = flight
+        return payload
 
 
 def absorb(payload: dict) -> None:
@@ -170,6 +221,7 @@ def absorb(payload: dict) -> None:
                 try:
                     if capped and not metric.has_series(
                             metric._tags_key(tags)):
+                        count_dropped("absorb_series")
                         continue
                     if kind == "counter" and isinstance(metric, Counter):
                         metric.inc(value, tags=tags)
@@ -186,8 +238,16 @@ def absorb(payload: dict) -> None:
                 _proc_names[int(pid)] = payload["proc"]
                 while len(_proc_names) > _PROC_NAMES_MAX:
                     _proc_names.pop(next(iter(_proc_names)))  # oldest
+                    count_dropped("proc_names")
             for event in payload.get("spans", ()):
+                if len(_remote_events) >= _REMOTE_EVENTS_MAX:
+                    count_dropped("remote_events")
                 _remote_events.append(event)
+    flight_events = payload.get("flight")
+    if flight_events:
+        from . import flight as flight_mod
+
+        flight_mod.ingest(flight_events)
 
 
 def remote_chrome_events() -> List[dict]:

@@ -44,8 +44,101 @@ def cmd_list(args) -> int:
         "workers": obs.list_workers,
         "placement-groups": obs.list_placement_groups,
     }
-    rows = fns[args.entity]()
+    # `rt list tasks --state RUNNING --filter resources.CPU=1.0`:
+    # equality filters, nested fields via dotted paths (tasks only —
+    # the other listings take no filters).
+    filters = {}
+    if getattr(args, "state", None):
+        filters["state"] = args.state
+    for item in getattr(args, "filter", None) or ():
+        if "=" not in item:
+            print(f"--filter wants key=value, got {item!r}",
+                  file=sys.stderr)
+            return 2
+        k, v = item.split("=", 1)
+        filters[k] = v
+    if filters and args.entity != "tasks":
+        print("--state/--filter only apply to `rt list tasks`",
+              file=sys.stderr)
+        return 2
+    rows = fns[args.entity](filters=filters) if filters \
+        else fns[args.entity]()
     print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    """``rt summary tasks``: per-function, per-stage latency p50/p99
+    from the flight recorder (reference: ``ray summary tasks`` over the
+    gcs_task_manager task events)."""
+    import ray_tpu as rt
+    from ray_tpu.observability import flight_summary, format_flight_summary
+
+    rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
+    data = flight_summary()
+    if args.json:
+        print(json.dumps(data, indent=2))
+    else:
+        print(format_flight_summary(data))
+    return 0
+
+
+def cmd_logs(args) -> int:
+    """``rt logs``: aggregate worker logs cluster-wide (reference:
+    ``ray logs`` + the log monitor -> driver printer pipeline).
+
+    Default: dump the tail of every session worker log file the head's
+    LogMonitor tracks, newest lines last. ``--follow`` subscribes to the
+    LOGS pubsub channel the monitor publishes on and streams until
+    Ctrl-C. ``--worker <hex-prefix>`` narrows either mode."""
+    import os
+
+    import ray_tpu as rt
+    from ray_tpu.core.runtime import get_head_runtime
+    from ray_tpu.observability.state import worker_log_tail
+
+    rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
+    runtime = get_head_runtime()
+    prefix = (args.worker or "").lower()
+    log_dir = getattr(runtime, "session_log_dir", None)
+    if not log_dir or not os.path.isdir(log_dir):
+        print("worker log capture is not enabled "
+              "(RT_WORKER_REDIRECT_LOGS=0?)", file=sys.stderr)
+        return 1
+    workers = sorted({name[len("worker-"):].partition(".")[0]
+                      for name in os.listdir(log_dir)
+                      if name.startswith("worker-")})
+    if prefix:
+        workers = [w for w in workers if w.startswith(prefix)]
+    for worker in workers:
+        tail = worker_log_tail(worker, n=args.lines)
+        for stream in ("out", "err"):
+            for line in tail.get(stream) or ():
+                print(f"(worker={worker} {stream}) {line.rstrip()}")
+    if not args.follow:
+        return 0
+
+    import time
+
+    from ray_tpu.core.log_monitor import CHANNEL
+
+    def _print(msg: dict) -> None:
+        if prefix and not str(msg.get("worker", "")).startswith(prefix):
+            return
+        stream = msg.get("stream", "out")
+        out = sys.stderr if stream == "err" else sys.stdout
+        print(f"(worker={str(msg.get('worker', ''))[:8]} {stream}) "
+              f"{msg.get('line', '')}", file=out, flush=True)
+
+    unsub = runtime.gcs.pubsub.subscribe(CHANNEL, _print)
+    print("-- following (Ctrl-C to stop) --", flush=True)
+    try:
+        while True:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        unsub()
     return 0
 
 
@@ -248,6 +341,25 @@ def build_parser() -> argparse.ArgumentParser:
     lp = sub.add_parser("list", help="list cluster entities")
     lp.add_argument("entity", choices=["nodes", "tasks", "actors", "objects",
                                        "workers", "placement-groups"])
+    lp.add_argument("--state", default=None,
+                    help="tasks only: filter by FSM state, e.g. "
+                         "--state RUNNING")
+    lp.add_argument("--filter", action="append", metavar="KEY=VALUE",
+                    help="tasks only: equality filter; dotted keys reach "
+                         "nested fields (resources.CPU=1.0)")
+    smp = sub.add_parser("summary", help="per-function per-stage latency "
+                                         "p50/p99 (flight recorder)")
+    smp.add_argument("entity", choices=["tasks"])
+    smp.add_argument("--json", action="store_true",
+                     help="machine-readable instead of the table")
+    lgp = sub.add_parser("logs", help="tail/aggregate worker logs "
+                                      "cluster-wide (log monitor)")
+    lgp.add_argument("--worker", default=None,
+                     help="hex worker-id prefix to narrow to")
+    lgp.add_argument("-f", "--follow", action="store_true",
+                     help="stream new lines via the LOGS pubsub channel")
+    lgp.add_argument("-n", "--lines", type=int, default=100,
+                     help="tail this many lines per stream first")
     sub.add_parser("memory", help="object store usage")
     sub.add_parser("metrics", help="cluster metrics (Prometheus text)")
     tp = sub.add_parser("timeline", help="dump merged chrome://tracing json "
@@ -280,6 +392,8 @@ def main(argv=None) -> int:
         "start": cmd_start,
         "status": cmd_status,
         "list": cmd_list,
+        "summary": cmd_summary,
+        "logs": cmd_logs,
         "memory": cmd_memory,
         "metrics": cmd_metrics,
         "timeline": cmd_timeline,

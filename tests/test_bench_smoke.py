@@ -78,6 +78,31 @@ def test_bench_smoke_runs_all_stages():
     assert sess["warm_ttft_speedup"] >= 1.5, sess
     assert sess["prefix_tokens_saved"] > 0, sess
 
+    # Flight-recorder stage (ISSUE 16): per-stage task latency joined
+    # head-side with worker exec deltas, stage sums ~= end-to-end, and
+    # the LLM half commits per-request timing + the decode roofline
+    # fraction — which must also be visible in the /metrics scrape.
+    assert "bench_flight_error" not in result, result
+    fl = result["bench_flight"]
+    assert "task_join_timeout" not in fl, fl
+    assert fl["task_rows_joined"] > 0, fl
+    for stage in ("queue", "sched", "exec", "transfer", "total"):
+        assert fl[f"task_{stage}_ms_p50"] >= 0, fl
+        assert fl[f"task_{stage}_ms_p99"] >= fl[f"task_{stage}_ms_p50"], fl
+    assert fl["task_exec_ms_p50"] > 0, fl
+    # By construction queue+sched+exec+transfer == total; the 10%
+    # acceptance tolerance leaves room for clamping on degenerate rows.
+    assert abs(fl["task_stage_sum_frac_mean"] - 1.0) <= 0.1, fl
+    assert fl["llm_requests"] > 0, fl
+    for key in ("llm_prefill_ms_p50", "llm_decode_ms_p50",
+                "llm_total_ms_p50"):
+        assert fl[key] > 0, fl
+    assert fl["llm_decode_steps"] > 0, fl
+    assert fl["rt_llm_roofline_frac"] > 0, fl
+    assert scrape["rt_task_stage_seconds_count"] > 0, scrape
+    assert scrape["rt_llm_stage_seconds_count"] > 0, scrape
+    assert scrape["rt_llm_roofline_frac"] > 0, scrape
+
     # Head-failover recovery stage: subprocess heads on a shared WAL —
     # the chaos loop must actually kill and recover, committing latency.
     # (The stage degrades gracefully on toolchain-less hosts, matching

@@ -1,0 +1,297 @@
+"""Flight recorder: stage-attributed task latency (ISSUE 16).
+
+Reference coverage analog: the task-events backend tests
+(``gcs_task_manager`` + ``ray summary tasks``) — lifecycle transition
+timestamps recorded per task, worker exec durations joined head-side,
+per-function per-stage aggregates served through the CLI and dashboard.
+
+Covers: stamp monotonicity + stage-sum ≈ end-to-end (the acceptance
+criterion), the worker exec-delta join over the telemetry pipe, the
+``rt summary`` / ``rt list --state`` / ``rt logs`` CLI paths, the
+``rt_telemetry_dropped_total`` satellite, clean-store re-init, and the
+recorder surviving head failover (replacement head, fresh store).
+"""
+
+import json
+import logging
+import time
+
+import pytest
+
+from ray_tpu.core.gcs_socket import build_native
+
+
+def _wait_joined(name: str, n: int, timeout: float = 25.0):
+    """Poll until ``n`` tasks of ``name`` have their exec stage joined
+    (worker deltas ride the ~1s telemetry flush)."""
+    from ray_tpu.observability import recent_flight_tasks
+
+    deadline = time.monotonic() + timeout
+    rows = []
+    while time.monotonic() < deadline:
+        rows = [r for r in recent_flight_tasks(limit=500)
+                if name in r["name"]]
+        if len(rows) >= n:
+            return rows
+        time.sleep(0.25)
+    return rows
+
+
+def test_stage_stamps_monotonic_and_sum_to_total(rt_init):
+    """Per-task transition stamps are monotonic and the four stage
+    durations sum to within 10% of the end-to-end latency (they are
+    equal by construction; the tolerance absorbs clamping)."""
+    rt = rt_init
+
+    @rt.remote
+    def flightwork(x):
+        time.sleep(0.005)
+        return x * 2
+
+    assert rt.get([flightwork.remote(i) for i in range(12)],
+                  timeout=120) == [i * 2 for i in range(12)]
+
+    from ray_tpu.observability.state import list_tasks
+
+    rows = list_tasks(filters={"name": "flightwork", "state": "DONE"})
+    assert len(rows) == 12
+    for row in rows:
+        ts = row["state_ts"]
+        assert ts is not None, row
+        order = [ts["submitted"], ts["queued"], ts["scheduled"],
+                 ts["dispatched"], ts["finished"]]
+        assert order == sorted(order), ts
+
+    joined = _wait_joined("flightwork", 12)
+    assert len(joined) >= 12, "exec deltas never joined head-side"
+    for r in joined:
+        assert r["exec_s"] > 0, r  # the sleep(0.005) must be visible
+        stage_sum = (r["queue_s"] + r["sched_s"] + r["exec_s"]
+                     + r["transfer_s"])
+        assert stage_sum == pytest.approx(r["total_s"], rel=0.10), r
+
+
+def test_summary_aggregates_and_cli(rt_init, capsys):
+    """flight_summary() exposes per-stage count/p50/p99 per function;
+    ``rt summary tasks`` renders it, ``rt list tasks --state`` and
+    dotted ``--filter`` narrow the task table."""
+    rt = rt_init
+
+    @rt.remote
+    def agg(x):
+        return x + 1
+
+    rt.get([agg.remote(i) for i in range(8)], timeout=120)
+    assert len(_wait_joined("agg", 8)) >= 8
+
+    from ray_tpu.observability import flight_summary, format_flight_summary
+
+    summ = flight_summary()
+    row = next(v for k, v in summ.items() if "agg" in k)
+    assert row["count"] >= 8
+    for stage in ("queue", "sched", "exec", "transfer", "total"):
+        st = row["stages"][stage]
+        assert st["count"] >= 8
+        assert st["p99_ms"] >= st["p50_ms"] >= 0
+    assert "agg" in format_flight_summary()
+
+    from ray_tpu.scripts import cli
+
+    assert cli.main(["summary", "tasks", "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert any("agg" in k for k in data)
+
+    assert cli.main(["list", "tasks", "--state", "DONE"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert rows and all(r["state"] == "DONE" for r in rows)
+    # Dotted-path filter reaches nested fields (satellite 2).
+    assert cli.main(["list", "tasks", "--filter",
+                     "resources.CPU=1.0"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert rows and all(r["resources"]["CPU"] == 1.0 for r in rows)
+    # Filters reject non-task entities with a usage error, not silence.
+    assert cli.main(["list", "nodes", "--state", "DONE"]) == 2
+
+
+def test_rt_logs_tails_worker_output(rt_init, capsys):
+    """``rt logs`` dumps captured worker stdout/stderr with worker-id
+    prefixes (satellite 3; non-follow path)."""
+    rt = rt_init
+
+    @rt.remote
+    def chatty():
+        print("flight logs probe line")
+        return 1
+
+    assert rt.get(chatty.remote()) == 1
+
+    import os
+
+    from ray_tpu.core.runtime import get_head_runtime
+    from ray_tpu.scripts import cli
+
+    log_dir = get_head_runtime().session_log_dir
+    assert log_dir, "worker log capture should be on by default"
+    # Wait for the redirected line to land in a worker log file, then
+    # let the monitor's async driver echo drain so the capsys read
+    # below sees ONLY what `rt logs` itself printed.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if any("flight logs probe line" in open(
+                os.path.join(log_dir, name)).read()
+               for name in os.listdir(log_dir)
+               if name.startswith("worker-") and name.endswith(".out")):
+            break
+        time.sleep(0.1)
+    time.sleep(1.0)
+    capsys.readouterr()
+    assert cli.main(["logs"]) == 0
+    out = capsys.readouterr().out
+    assert "flight logs probe line" in out
+    assert "(worker=" in out
+    # --worker with a non-matching prefix filters everything out.
+    assert cli.main(["logs", "--worker", "zzzzzzzz"]) == 0
+    assert "flight logs probe line" not in capsys.readouterr().out
+
+
+def test_dropped_counter_and_warn_once(caplog):
+    """Bounded telemetry buffers count drops in
+    rt_telemetry_dropped_total{buffer} and warn once per buffer
+    (satellite 1)."""
+    from ray_tpu.observability import telemetry
+    from ray_tpu.observability.metrics import registry
+
+    def total(buffer):
+        ctr = registry.get("rt_telemetry_dropped_total")
+        if ctr is None:
+            return 0.0
+        return sum(v for k, v in ctr.collect()[1].items()
+                   if ("buffer", buffer) in k)
+
+    exp = telemetry.TelemetryExporter(proc="droptest")
+    for _ in range(telemetry._FLIGHT_BUF_MAX):
+        exp._flight.append(("00", 0.0))
+    before = total("flight_exporter")
+    exp.record_flight("aa", 0.001)
+    exp.record_flight("bb", 0.001)
+    assert total("flight_exporter") == before + 2
+
+    with caplog.at_level(logging.WARNING,
+                         logger="ray_tpu.observability.telemetry"):
+        telemetry.count_dropped("flight_test_unique")
+        telemetry.count_dropped("flight_test_unique")
+    warns = [r for r in caplog.records
+             if "flight_test_unique" in r.getMessage()]
+    assert len(warns) == 1, "must warn exactly once per buffer"
+    assert total("flight_test_unique") == 2
+
+
+def test_clean_store_on_reinit():
+    """A new runtime in the same process (shutdown -> init, the
+    in-process half of head replacement) starts with an EMPTY flight
+    store — stale aggregates from the previous runtime's tasks must not
+    leak into the new head's summary (satellite 4)."""
+    import ray_tpu as rt
+
+    if rt.is_initialized():
+        rt.shutdown()
+    rt.init(num_cpus=2)
+    try:
+        @rt.remote
+        def stale(x):
+            return x
+
+        rt.get([stale.remote(i) for i in range(4)], timeout=120)
+        from ray_tpu.observability import flight_summary
+
+        deadline = time.monotonic() + 25
+        while time.monotonic() < deadline:
+            if any("stale" in k for k in flight_summary()):
+                break
+            time.sleep(0.25)
+        assert any("stale" in k for k in flight_summary())
+    finally:
+        rt.shutdown()
+    rt.init(num_cpus=2)
+    try:
+        assert flight_summary() == {}, \
+            "replacement runtime inherited the old flight store"
+    finally:
+        rt.shutdown()
+
+
+# Driver script for the failover cycle: the PR-14 named-actor recovery
+# workload plus a flight-recorded task batch; READY reports how many
+# tasks joined exec deltas and that the summary renders.
+_SRC_FLIGHT = r"""
+import time
+import ray_tpu as rt
+from ray_tpu.observability import (flight_summary, format_flight_summary,
+                                   recent_flight_tasks)
+
+rt.init(num_cpus=2)
+
+
+@rt.remote
+def fwork(x):
+    return x * 2
+
+
+@rt.remote
+class Counter:
+    def bump(self):
+        return 1
+
+
+try:
+    h = rt.get_actor("survivor")
+    created = 0
+except ValueError:
+    h = Counter.options(name="survivor", max_restarts=5).remote()
+    created = 1
+v = rt.get(h.bump.remote(), timeout=120)
+assert rt.get([fwork.remote(i) for i in range(8)], timeout=120) == \
+    [i * 2 for i in range(8)]
+deadline = time.time() + 20
+joined = 0
+while time.time() < deadline:
+    joined = sum(1 for r in recent_flight_tasks(limit=500)
+                 if "fwork" in r["name"])
+    if joined >= 8:
+        break
+    time.sleep(0.25)
+summ = flight_summary()
+fn_row = next((v2 for k, v2 in summ.items() if "fwork" in k), None)
+exec_count = (fn_row or {}).get("stages", {}).get("exec",
+                                                  {}).get("count", 0)
+table_ok = int("fwork" in format_flight_summary())
+print("HEADKILLER_READY value=%d created=%d joined=%d exec_count=%d "
+      "table_ok=%d" % (v, created, joined, exec_count, table_ok),
+      flush=True)
+while True:
+    rt.get(h.bump.remote())
+    time.sleep(0.005)
+"""
+
+
+@pytest.mark.skipif(not build_native(),
+                    reason="native toolchain unavailable")
+def test_flight_survives_head_failover(tmp_path):
+    """SIGKILL the head mid-workload; the replacement head's flight
+    recorder starts clean and records ONLY its own tasks — exec joins
+    work and ``rt summary`` renders on the replacement too."""
+    from ray_tpu.cluster_utils import HeadKiller
+
+    killer = HeadKiller(str(tmp_path / "gcs.wal"), kill_after_s=0.3,
+                        head_src=_SRC_FLIGHT)
+    first = killer.run_cycle()
+    assert first["created"] == 1
+    assert first["joined"] == 8, first
+    assert first["table_ok"] == 1, first
+
+    second = killer.run_cycle()  # replacement head on the same WAL
+    assert second["created"] == 0, "named actor must re-resolve"
+    # Clean store: exactly THIS head's 8 tasks, nothing inherited.
+    assert second["joined"] == 8, second
+    assert second["exec_count"] == 8, second
+    assert second["table_ok"] == 1, second
