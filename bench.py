@@ -183,6 +183,11 @@ def main():
         result["llm_sessions_error"] = repr(e)[:300]
     gc.collect()
     try:
+        result["llm_longgen"] = bench_llm_longgen(on_tpu)
+    except Exception as e:
+        result["llm_longgen_error"] = repr(e)[:300]
+    gc.collect()
+    try:
         result["long_context"] = bench_long_context(on_tpu)
     except Exception as e:
         result["long_context_error"] = repr(e)[:300]
@@ -882,6 +887,127 @@ def bench_llm(on_tpu: bool) -> dict:
     return out
 
 
+def bench_llm_longgen(on_tpu: bool, smoke: bool = False) -> dict:
+    """Long-generation decode throughput vs the HBM roof (ISSUE 17 —
+    this PR's headline number). All slots prefill up front, then the
+    engine sits in the pure ``decode_only_fn`` loop for the whole
+    generation: the profiler window is RESET after the last prefill so
+    ``roofline_frac`` measures steady-state decode alone, not pipeline
+    fill. Commits tok/s, the decode block size, the roofline fraction,
+    and the bytes-per-step attribution (params vs KV pages) — the
+    decode-step profile the acceptance criterion asks for when the
+    fraction lands under 0.5. A tp2 parity sub-stage reruns a short
+    greedy generation on a 2-device tp mesh and asserts bit-for-bit
+    token parity vs tp1; skipped cleanly when the host only has one
+    device."""
+    import gc
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.llm.engine import SlotEngine
+    from ray_tpu.models import llama
+
+    fast = smoke and os.environ.get("BENCH_SMOKE_FAST") == "1"
+    if on_tpu:
+        model, slots, chunk, ps = "llama-1b", 8, 128, 16
+        prompt_len, max_new = 128, 1024
+        block = int(os.environ.get("BENCH_LLM_LONGGEN_BLOCK", "32"))
+    else:
+        model, slots, chunk, ps = "llama-tiny", 4, 8, 8
+        prompt_len, max_new = 12, 32 if fast else 64
+        block = int(os.environ.get("BENCH_LLM_LONGGEN_BLOCK", "4"))
+    cfg = llama.CONFIGS[model]
+    params, _ = llama.init_params(jax.random.PRNGKey(0), cfg)
+    params = jax.tree.map(lambda x: x.astype(cfg.dtype), params)
+    engine = SlotEngine(params, cfg, num_slots=slots, chunk=chunk,
+                        decode_block=block, page_size=ps)
+    engine.warmup()
+    rng = np.random.default_rng(0)
+    handles = [
+        engine.submit(
+            rng.integers(1, cfg.vocab_size, size=prompt_len).tolist(),
+            max_new=max_new)
+        for _ in range(slots)
+    ]
+    # Phase 1: drive until every slot has produced its first token —
+    # all prefill chunks and the fused-program dispatches are behind us.
+    guard = 0
+    while not all(h._tokens for h in handles):
+        engine.step()
+        guard += 1
+        assert guard < 100_000, "longgen prefill phase did not converge"
+    # Phase 2: pure long-gen decode, measured on a fresh roofline
+    # window (satellite: the window-reset API exists exactly for this).
+    engine.reset_decode_profile()
+    produced0 = sum(len(h._tokens) for h in handles)
+    t0 = time.perf_counter()
+    while engine.step():
+        pass
+    dt = time.perf_counter() - t0
+    assert all(h.result(timeout=0).finish_reason == "length"
+               for h in handles)
+    produced = sum(len(h.result(timeout=0).tokens) for h in handles)
+    prof = engine.decode_profile()
+    kv_bytes = (engine._pool.used_count * engine._kv_page_bytes)
+    out = {
+        "model": model,
+        "tokens_per_s_longgen": round((produced - produced0) / dt, 1),
+        "decode_block": block,
+        "long_new_tokens": max_new,
+        "concurrent_slots": slots,
+        "decode_steps": prof["steps"],
+        "steps_per_s": prof["steps_per_s"],
+        "avg_step_ms": prof["avg_step_ms"],
+        "roofline_frac": round(prof["roofline_frac"], 4),
+        "achieved_gbps": prof["achieved_gbps"],
+        "hbm_gbps": prof["hbm_gbps"],
+        "devices": prof["devices"],
+        # Decode-step byte attribution: where a step's HBM traffic goes.
+        # At 1B scale the params stream dominates until the pool fills;
+        # the KV share grows linearly over a long generation.
+        "bytes_per_step": prof["bytes_per_step"],
+        "param_bytes": engine._param_bytes,
+        "kv_resident_bytes_end": kv_bytes,
+    }
+    del engine
+    gc.collect()
+    # tp2 parity sub-stage: greedy tokens over a 2-device tp mesh must
+    # be bit-for-bit the tp1 sequence (ROADMAP item 2's proof). Always
+    # on the tiny model — parity is a correctness property, not a perf
+    # number — and skipped cleanly on single-device hosts (a lone TPU
+    # chip or a CPU host without forced virtual devices).
+    if len(jax.devices()) >= 2:
+        from ray_tpu.parallel.mesh import MeshSpec
+
+        tiny = llama.CONFIGS["llama-tiny"]
+        tparams, _ = llama.init_params(jax.random.PRNGKey(0), tiny)
+        prompt = rng.integers(1, tiny.vocab_size, size=17).tolist()
+
+        def _run(mesh):
+            eng = SlotEngine(tparams, tiny, num_slots=2, chunk=8,
+                             page_size=8, decode_block=2, mesh=mesh)
+            h = eng.submit(prompt, max_new=12)
+            guard = 0
+            while not h._done.is_set():
+                eng.step()
+                guard += 1
+                assert guard < 10_000
+            sharding = eng._cache["kv"].sharding
+            kv_spec = getattr(sharding, "spec", None)
+            return h.result(timeout=0).tokens, kv_spec
+
+        t1, _ = _run(None)
+        mesh = MeshSpec(tp=2).build(jax.devices()[:2])
+        t2, kv_spec = _run(mesh)
+        out["tp2_token_parity"] = t1 == t2
+        out["tp2_kv_spec"] = str(kv_spec)
+        gc.collect()
+    else:
+        out["tp2"] = "skipped (single host device)"
+    return out
+
+
 def bench_llm_sessions(on_tpu: bool, smoke: bool = False) -> dict:
     """Multi-turn chat serving over a SHARED system prompt (ISSUE 15 /
     ROADMAP item 3): N sessions x M turns, every turn's prompt = system
@@ -1357,6 +1483,12 @@ def smoke() -> dict:
         result["llm_sessions"] = bench_llm_sessions(False, smoke=True)
     except Exception as e:  # noqa: BLE001
         result["llm_sessions_error"] = repr(e)[:300]
+    # Long-gen decode + roofline stage (ISSUE 17), incl. the tp2 parity
+    # sub-stage when the host exposes >= 2 (possibly virtual) devices.
+    try:
+        result["llm_longgen"] = bench_llm_longgen(False, smoke=True)
+    except Exception as e:  # noqa: BLE001
+        result["llm_longgen_error"] = repr(e)[:300]
     # Flight-recorder stage BEFORE the scrape: it sets the roofline
     # gauge and observes the stage histograms this process's /metrics
     # must then contain.

@@ -179,12 +179,19 @@ class _Slot:
 class SlotEngine:
     """Continuous-batching generation over a paged KV-cache pool."""
 
+    # Serving rule table deltas over parallel.sharding.DEFAULT_RULES:
+    # the page pool's heads axis is the KV-heads axis, which the default
+    # (training) table leaves replicated — tp-sharded serving maps it to
+    # tp so the KV pages (the decode bandwidth bill) split across chips.
+    SERVE_RULES = {"kv": "tp"}
+
     def __init__(self, params, cfg: llama.LlamaConfig, num_slots: int = 8,
                  chunk: int = 64, seed: int = 0, decode_block: int = 1,
                  page_size: int = 16, num_pages: Optional[int] = None,
                  prefix_cache: bool = True,
                  max_pending: Optional[int] = None,
-                 queue_timeout_s: Optional[float] = None):
+                 queue_timeout_s: Optional[float] = None,
+                 mesh=None, rules=None):
         if cfg.max_seq % chunk != 0:
             raise ValueError(
                 f"chunk ({chunk}) must divide max_seq ({cfg.max_seq}): "
@@ -209,7 +216,31 @@ class SlotEngine:
         self.decode_block = decode_block
         self.max_pending = max_pending
         self.queue_timeout_s = queue_timeout_s
-        self._params = jax.device_put(params)
+        # Mesh-sharded serving (ROADMAP item 2): with a mesh, params
+        # shard by their logical axes (heads/mlp/vocab over tp) and the
+        # page pool's KV-heads axis shards over tp — each chip holds
+        # 1/tp of the weights AND 1/tp of every KV page, so a model too
+        # big for one chip's HBM serves from several. Without a mesh
+        # every constraint no-ops and placement is plain device_put.
+        self._mesh = mesh
+        if mesh is not None:
+            from ..parallel import sharding as shd
+
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            tp = sizes.get("tp", 1)
+            if tp > 1 and (cfg.num_kv_heads % tp or cfg.num_heads % tp
+                           or cfg.d_mlp % tp or cfg.vocab_size % tp):
+                raise ValueError(
+                    f"tp={tp} must divide num_kv_heads "
+                    f"({cfg.num_kv_heads}), num_heads ({cfg.num_heads}), "
+                    f"d_mlp ({cfg.d_mlp}) and vocab ({cfg.vocab_size})")
+            self._rules = shd.prune_rules_for_mesh(
+                mesh, dict(self.SERVE_RULES, **(rules or {})))
+            self._params = shd.place(mesh, params, llama.param_axes(),
+                                     self._rules)
+        else:
+            self._rules = None
+            self._params = jax.device_put(params)
         self._pages_per_seq = cfg.max_seq // page_size
         # Pool default: exactly the dense footprint (num_slots full
         # sequences) plus the single reserved scratch page — the old
@@ -226,9 +257,19 @@ class SlotEngine:
                                 dtype=np.int32)
         self._cache = llama.init_paged_kv_cache(cfg, self._num_pages,
                                                 page_size)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from ..parallel import sharding as shd
+
+            kv_sharding = NamedSharding(
+                mesh, shd.spec_for(llama.PAGED_KV_AXES, self._rules))
+            self._cache = jax.tree.map(
+                lambda x: jax.device_put(x, kv_sharding), self._cache)
         self._base_seed = seed
         self._req_counter = 0
         ps = page_size
+        rules_ = self._rules
 
         def block_fn(params, cache, tables, override_vals, override_mask,
                      prev_last, pos, temps, seeds,
@@ -242,7 +283,8 @@ class SlotEngine:
             dec_logits, pre_logits, cache = \
                 llama.decode_slots_with_prefill_paged(
                     params, cache, tables, tokens0, pos, pre_tokens,
-                    pre_slot, pre_p0, pre_n_valid, cfg, ps)
+                    pre_slot, pre_p0, pre_n_valid, cfg, ps,
+                    rules=rules_)
             tok1 = _sample(dec_logits, temps, seeds, pos + 1)
             pre_tok = _sample(pre_logits[None], pre_temp[None],
                               pre_seed[None],
@@ -251,7 +293,8 @@ class SlotEngine:
             def body(carry, _):
                 toks, cache, p = carry
                 logits, cache = llama.decode_slots_paged(
-                    params, cache, tables, toks, p, cfg, ps)
+                    params, cache, tables, toks, p, cfg, ps,
+                    rules=rules_)
                 nxt = _sample(logits, temps, seeds, p + 1)
                 return (nxt, cache, p + 1), nxt
 
@@ -271,7 +314,8 @@ class SlotEngine:
             def body(carry, _):
                 toks, cache, p = carry
                 logits, cache = llama.decode_slots_paged(
-                    params, cache, tables, toks, p, cfg, ps)
+                    params, cache, tables, toks, p, cfg, ps,
+                    rules=rules_)
                 nxt = _sample(logits, temps, seeds, p + 1)
                 return (nxt, cache, p + 1), nxt
 
@@ -280,10 +324,23 @@ class SlotEngine:
             return toks_k, last, cache
 
         # The cache is donated: XLA updates it in place, so a decode
-        # step never copies the (potentially multi-GB) KV pages.
-        self._block = jax.jit(block_fn, donate_argnums=(1,))
-        self._decode_only = jax.jit(decode_only_fn, donate_argnums=(1,))
-        self._copy_pages = jax.jit(llama.copy_pages, donate_argnums=(0,))
+        # step never copies the (potentially multi-GB) KV pages. Under
+        # a mesh, every compiled-program call is wrapped so constrain()
+        # resolves (ambient mesh + current-mesh global); the in-kernel
+        # constraints pin the output cache to the input's sharding, so
+        # donation stays an in-place aliasing across steps.
+        def _maybe_mesh(fn):
+            if mesh is None:
+                return fn
+            from ..parallel.sharding import under_mesh
+
+            return under_mesh(mesh, fn)
+
+        self._block = _maybe_mesh(jax.jit(block_fn, donate_argnums=(1,)))
+        self._decode_only = _maybe_mesh(
+            jax.jit(decode_only_fn, donate_argnums=(1,)))
+        self._copy_pages = _maybe_mesh(
+            jax.jit(llama.copy_pages, donate_argnums=(0,)))
         # Pre-compile the COW page-copy program NOW, while no engine
         # thread can be touching the (donated) cache: the first partial
         # prefix hit must not stall on a compile, and compiling from
@@ -754,19 +811,34 @@ class SlotEngine:
             m["decode_per_token"].observe(timing["decode_per_token_s"])
         return timing
 
+    def reset_decode_profile(self) -> None:
+        """Zero the roofline window. Successive bench stages call this
+        between phases so each measures its OWN steady-state interval —
+        without it, a long-gen stage inherits the warmup/prefill
+        stage's lag-1 state and pollutes its bytes/s estimate."""
+        self._prof_steps = 0
+        self._prof_wall = 0.0
+        self._prof_bytes = 0.0
+        self._prof_t0 = None
+
     def decode_profile(self) -> dict:
         """Achieved-vs-peak HBM accounting for the decode loop
         (ROADMAP item 2's ``roofline_frac``). Publishes the
-        ``rt_llm_roofline_frac`` gauge as a side effect."""
+        ``rt_llm_roofline_frac`` / ``rt_llm_decode_steps_per_s``
+        gauges as a side effect. The roof scales with the mesh size:
+        a tp-sharded pool streams 1/n of the bytes per chip, so the
+        aggregate peak is n chips' bandwidth."""
         from ..core.config import config
 
         steps, wall = self._prof_steps, self._prof_wall
         hbm_gbps = float(config().hbm_bandwidth_gbps)
+        devices = 1 if self._mesh is None else int(self._mesh.devices.size)
+        peak_gbps = hbm_gbps * devices
         if steps == 0 or wall <= 0.0:
             prof = {"steps": 0, "wall_s": 0.0, "avg_step_ms": 0.0,
                     "steps_per_s": 0.0, "bytes_per_step": 0,
                     "achieved_gbps": 0.0, "hbm_gbps": hbm_gbps,
-                    "roofline_frac": 0.0}
+                    "devices": devices, "roofline_frac": 0.0}
         else:
             achieved_gbps = self._prof_bytes / wall / 1e9
             prof = {
@@ -777,11 +849,17 @@ class SlotEngine:
                 "bytes_per_step": int(self._prof_bytes / steps),
                 "achieved_gbps": round(achieved_gbps, 4),
                 "hbm_gbps": hbm_gbps,
-                "roofline_frac": achieved_gbps / hbm_gbps,
+                "devices": devices,
+                # Guarded: hbm_bandwidth_gbps <= 0 (unknown hardware /
+                # disabled roof) must degrade to frac 0.0, never
+                # ZeroDivisionError the engine's stats path.
+                "roofline_frac": (achieved_gbps / peak_gbps
+                                  if peak_gbps > 0 else 0.0),
             }
         m = llm_metrics()
         if m is not None:
             m["roofline_frac"].set(prof["roofline_frac"])
+            m["decode_steps"].set(prof["steps_per_s"])
         return prof
 
     def _deliver(self, idx: int, s: _Slot, tok: int) -> None:

@@ -276,6 +276,10 @@ def llm_metrics() -> Optional[Dict[str, Any]]:
                 "roofline_frac": get_or_create(
                     Gauge, "rt_llm_roofline_frac",
                     "Achieved decode HBM bytes/s over the configured "
-                    "peak bandwidth (hbm_bandwidth_gbps)"),
+                    "peak bandwidth (hbm_bandwidth_gbps x mesh size)"),
+                "decode_steps": get_or_create(
+                    Gauge, "rt_llm_decode_steps_per_s",
+                    "Steady-state decode steps/s over the current "
+                    "roofline window"),
             }
         return _llm_metrics_cache

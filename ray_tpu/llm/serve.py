@@ -59,9 +59,23 @@ class LLMServer:
                  page_size: int = 16, num_pages: Optional[int] = None,
                  prefix_cache: bool = True,
                  max_pending: Optional[int] = 256,
-                 queue_timeout_s: Optional[float] = 30.0):
+                 queue_timeout_s: Optional[float] = 30.0,
+                 decode_block: int = 1, tp: int = 1):
         params, cfg = _build_params(model, seed, checkpoint_path)
         self.default_max_tokens = default_max_tokens
+        # tp > 1: tensor-shard this replica over the first tp local
+        # devices — params by their logical axes, KV pages on the
+        # kv-heads axis (SlotEngine.SERVE_RULES). Per-request fold_in
+        # sampling keeps outputs bit-for-bit identical to tp=1.
+        mesh = None
+        if tp > 1:
+            from ..parallel.mesh import MeshSpec
+
+            devs = jax.devices()
+            if len(devs) < tp:
+                raise ValueError(
+                    f"tp={tp} needs {tp} devices, have {len(devs)}")
+            mesh = MeshSpec(tp=tp).build(devs[:tp])
         # Per-deployment admission control: the pending queue is BOUNDED
         # (max_pending) and queued requests expire after queue_timeout_s
         # — both shed load as a typed OverloadedError that the HTTP
@@ -72,7 +86,8 @@ class LLMServer:
                                  page_size=page_size, num_pages=num_pages,
                                  prefix_cache=prefix_cache,
                                  max_pending=max_pending,
-                                 queue_timeout_s=queue_timeout_s)
+                                 queue_timeout_s=queue_timeout_s,
+                                 decode_block=decode_block, mesh=mesh)
         self.engine.warmup()  # compile before the replica is routable
         self.engine.start()
 
@@ -152,6 +167,7 @@ def build_llm_app(model: str = "llama-tiny", num_slots: int = 8,
                   prefix_cache: bool = True,
                   max_pending: Optional[int] = 256,
                   queue_timeout_s: Optional[float] = 30.0,
+                  decode_block: int = 1, tp: int = 1,
                   **deploy_opts):
     """Build a Serve application for ``serve.run`` hosting the engine."""
     from ..serve import deployment
@@ -161,4 +177,5 @@ def build_llm_app(model: str = "llama-tiny", num_slots: int = 8,
                     seed=seed, checkpoint_path=checkpoint_path,
                     page_size=page_size, num_pages=num_pages,
                     prefix_cache=prefix_cache, max_pending=max_pending,
-                    queue_timeout_s=queue_timeout_s)
+                    queue_timeout_s=queue_timeout_s,
+                    decode_block=decode_block, tp=tp)

@@ -55,6 +55,27 @@ CONFIGS = {
 }
 
 
+def param_axes() -> Dict:
+    """Logical-axis tree matching :func:`init_params`' pytree — the
+    input to ``parallel.sharding.place``/``shardings_for`` when placing
+    params on a mesh (training AND the tp-sharded serving engine)."""
+    return {
+        "wte": ("vocab", "embed"),
+        "blocks": {
+            "attn_norm": ("layers", None),
+            "wq": ("layers", "embed", "qkv"),
+            "wk": ("layers", "embed", "kv"),
+            "wv": ("layers", "embed", "kv"),
+            "wo": ("layers", "qkv", "embed"),
+            "ffn_norm": ("layers", None),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": (None,),
+    }
+
+
 def init_params(key, cfg: LlamaConfig) -> Tuple[Dict, Dict]:
     keys = jax.random.split(key, 8)
     d, m, L = cfg.d_model, cfg.d_mlp, cfg.num_layers
@@ -77,22 +98,7 @@ def init_params(key, cfg: LlamaConfig) -> Tuple[Dict, Dict]:
         },
         "final_norm": jnp.ones((d,)),
     }
-    axes = {
-        "wte": ("vocab", "embed"),
-        "blocks": {
-            "attn_norm": ("layers", None),
-            "wq": ("layers", "embed", "qkv"),
-            "wk": ("layers", "embed", "kv"),
-            "wv": ("layers", "embed", "kv"),
-            "wo": ("layers", "qkv", "embed"),
-            "ffn_norm": ("layers", None),
-            "w_gate": ("layers", "embed", "mlp"),
-            "w_up": ("layers", "embed", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
-        },
-        "final_norm": (None,),
-    }
-    return params, axes
+    return params, param_axes()
 
 
 def rope(x, positions, theta: float):
@@ -461,59 +467,144 @@ def prefill_chunk(params, cache, tokens, slot, p0, cfg: LlamaConfig,
 # RadixAttention (SGLang) re-expressed in this repo's two-XLA-program
 # style: plain gather/scatter by physical page id, no custom kernel.
 #
-# Layout: cache[k|v] is [L, num_pages, Hkv, page_size, hd]; a page table
-# row [P] (P = max_seq // page_size) maps a slot's logical page l to a
-# physical page id. Physical page 0 is the RESERVED SCRATCH page: every
-# invalid write (parked slots, chunk tail padding, position overshoot)
-# is routed there explicitly, so garbage can never land in a real —
-# possibly shared — page. Unallocated page-table entries are 0 for the
-# same reason. Positions in unallocated logical pages are always
-# > the slot's current pos, so attention masks them before they are
-# ever read.
+# Layout: cache["kv"] is ONE fused array [L, 2, num_pages, page_size,
+# Hkv, hd] (index 0 = K, 1 = V) in HEADS-MINOR page order: a physical
+# page's row is a contiguous [page_size, Hkv, hd] block, so gathering a
+# slot's pages by table row is a contiguous per-page copy and the
+# gathered view reshapes to seq-major [S, Hkv, hd] for FREE — the old
+# heads-major layout ([.., Hkv, page_size, hd]) needed a transpose that
+# materialized the whole gathered cache every decode step. Fusing K and
+# V into one array halves the number of gather ops per layer (page-
+# gather fusion): one indexed read serves both attention operands.
+# A page table row [P] (P = max_seq // page_size) maps a slot's logical
+# page l to a physical page id. Physical page 0 is the RESERVED SCRATCH
+# page: every invalid write (parked slots, chunk tail padding, position
+# overshoot) is routed there explicitly, so garbage can never land in a
+# real — possibly shared — page. Unallocated page-table entries are 0
+# for the same reason. Positions in unallocated logical pages are
+# always > the slot's current pos, so attention masks them before they
+# are ever read.
+#
+# Sharding: every paged kernel takes an optional ``rules`` table
+# (logical axis -> mesh axis). Under a tp mesh the serving engine maps
+# the "kv" logical axis to tp, so the page pool's Hkv axis — and the
+# q/k/v head axes of every intermediate — shard across chips while the
+# page/seq axes stay replicated; with no mesh the constraints no-op and
+# the kernels are byte-identical to the single-device path.
 # ---------------------------------------------------------------------------
 
 def init_paged_kv_cache(cfg: LlamaConfig, num_pages: int, page_size: int):
     if cfg.max_seq % page_size != 0:
         raise ValueError(
             f"page_size ({page_size}) must divide max_seq ({cfg.max_seq})")
-    shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page_size,
+    shape = (cfg.num_layers, 2, num_pages, page_size, cfg.num_kv_heads,
              cfg.head_dim)
-    return {
-        "k": jnp.zeros(shape, cfg.dtype),
-        "v": jnp.zeros(shape, cfg.dtype),
-    }
+    return {"kv": jnp.zeros(shape, cfg.dtype)}
 
 
-def _gather_pages(cache_l, tables, cfg: LlamaConfig):
-    """[NP, Hkv, ps, hd] gathered by tables [B, P] -> [B, Hkv, S, hd].
+# Logical axes of cache["kv"] — the heads axis shards under the "kv"
+# rule (the serving engine maps it to tp).
+PAGED_KV_AXES = (None, None, None, None, "kv", None)
+
+
+def _gather_pages(kv_l, tables):
+    """ONE fused gather: [2, NP, ps, Hkv, hd] by tables [B, P] ->
+    seq-major [2, B, P*ps, Hkv, hd] (0 = K, 1 = V).
 
     The gathered view puts logical page l's slot (offset o) at sequence
     position l * ps + o, so positions/masks are identical to the dense
-    layout — the paths differ only in where bytes physically live."""
+    layout — the paths differ only in where bytes physically live.
+    Heads-minor pages make the reshape to seq-major free (each page row
+    is already a contiguous [ps, Hkv, hd] block)."""
     b, p = tables.shape
-    kp = cache_l[tables]  # [B, P, Hkv, ps, hd]
-    ps = kp.shape[3]
-    return kp.transpose(0, 2, 1, 3, 4).reshape(
-        b, cfg.num_kv_heads, p * ps, kp.shape[4])
+    g = kv_l[:, tables]  # [2, B, P, ps, Hkv, hd] — contiguous per page
+    return g.reshape(2, b, p * g.shape[3], g.shape[4], g.shape[5])
 
 
-def _scatter_token_kv(k_cache, v_cache, kn, vn, tables, rows, pos,
+def _scatter_token_kv(kv_l, kn, vn, tables, rows, pos,
                       page_size: int, max_seq: int):
-    """Scatter one token per row: row r's K/V lands in physical page
-    tables[rows[r], pos[r] // ps] at offset pos[r] % ps. Writes at
-    pos >= max_seq (parked rows / overshoot) are routed to the scratch
-    page so they can never corrupt a live page. kn/vn: [B, Hkv, hd]."""
+    """Scatter one token per row into the fused cache: row r's K/V
+    lands in physical page tables[rows[r], pos[r] // ps] at offset
+    pos[r] % ps. Writes at pos >= max_seq (parked rows / overshoot) are
+    routed to the scratch page so they can never corrupt a live page.
+    kn/vn: [B, Hkv, hd]; one scatter covers both K and V."""
     p = tables.shape[1]
     valid = pos < max_seq
     lpage = jnp.minimum(pos // page_size, p - 1)
     phys = jnp.where(valid, tables[rows, lpage], 0)
     off = jnp.where(valid, pos % page_size, 0)
-    return (k_cache.at[phys, :, off, :].set(kn),
-            v_cache.at[phys, :, off, :].set(vn))
+    return kv_l.at[:, phys, off].set(jnp.stack([kn, vn]))
+
+
+def _gqa_paged_attention(q, kv, mask, cfg: LlamaConfig):
+    """Grouped-query attention of q against a fused SEQ-MAJOR cache
+    view, without materializing the repeated KV heads.
+
+    q: [B, H, C, hd]; kv: [2, B, S, Hkv, hd] (heads-minor, as
+    :func:`_gather_pages` returns it — no transpose needed); mask
+    broadcastable to [B, Hkv, G, C, S]. Returns [B, C, D]."""
+    b, h, c, hd = q.shape
+    hkv = cfg.num_kv_heads
+    g = h // hkv
+    scale = 1.0 / math.sqrt(hd)
+    qg = q.reshape(b, hkv, g, c, hd)
+    # bf16 operands + fp32 accumulation: an explicit .astype(f32) here
+    # would materialize an fp32 copy of the whole KV cache every step —
+    # at decode time the cache read IS the bandwidth bill.
+    scores = jnp.einsum("bkgcd,bskd->bkgcs", qg, kv[0],
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bkgcs,bskd->bkgcd", probs.astype(kv.dtype), kv[1])
+    return o.reshape(b, h, c, hd).transpose(0, 2, 1, 3).reshape(
+        b, c, cfg.d_model)
+
+
+def _paged_layer_step(x, p, cfg: LlamaConfig, positions, kv_mask,
+                      write_kv, attend_view, rules=None):
+    """Shared per-layer block for the PAGED cache paths — the paged
+    twin of :func:`_cache_layer_step`, differing in the fused
+    heads-minor cache (``write_kv`` lands new K/V by physical page id,
+    ``attend_view`` gathers a seq-major [2, B, S, Hkv, hd] view) and in
+    carrying logical-axis sharding constraints: under a tp mesh q/k/v
+    shard on their head axes and the page pool on Hkv; with no mesh
+    every constraint is a no-op.
+
+    x: [B, T, D]. Returns (x, kv_l)."""
+    b, t, _ = x.shape
+    h, hd, hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
+    y = rms_norm(x, p["attn_norm"])
+    q = (y @ p["wq"].astype(y.dtype)).reshape(b, t, h, hd).transpose(
+        0, 2, 1, 3)
+    k_new = (y @ p["wk"].astype(y.dtype)).reshape(
+        b, t, hkv, hd).transpose(0, 2, 1, 3)
+    v_new = (y @ p["wv"].astype(y.dtype)).reshape(
+        b, t, hkv, hd).transpose(0, 2, 1, 3)
+    q = rope(q, positions, cfg.rope_theta)
+    k_new = rope(k_new, positions, cfg.rope_theta)
+    q = constrain(q, (None, "heads", None, None), rules)
+    k_new = constrain(k_new, (None, "kv", None, None), rules)
+    v_new = constrain(v_new, (None, "kv", None, None), rules)
+    kv_l = write_kv(k_new, v_new)
+    # Pin the written pool AND the gathered view to the kv-heads
+    # sharding: the scatter/gather must never trigger a resharding of
+    # the (multi-GB) page pool, and the scan-stacked output must match
+    # the donated input's sharding so in-place donation survives.
+    kv_l = constrain(kv_l, PAGED_KV_AXES[1:], rules)
+    kv_att = constrain(attend_view(kv_l), (None, None, None, "kv", None),
+                       rules)
+    o = _gqa_paged_attention(q, kv_att, kv_mask, cfg)
+    x = x + o @ p["wo"].astype(o.dtype)
+    y = rms_norm(x, p["ffn_norm"])
+    gate = jax.nn.silu(y @ p["w_gate"].astype(y.dtype))
+    up = y @ p["w_up"].astype(y.dtype)
+    hidden = constrain(gate * up, (None, None, "mlp"), rules)
+    x = x + hidden @ p["w_down"].astype(y.dtype)
+    return x, kv_l
 
 
 def decode_slots_paged(params, cache, tables, tokens, pos,
-                       cfg: LlamaConfig, page_size: int):
+                       cfg: LlamaConfig, page_size: int, rules=None):
     """``decode_slots`` over a paged cache: one decode step with
     per-slot positions, gathering each slot's pages through its page
     table row and scattering the new K/V by physical page id.
@@ -530,28 +621,27 @@ def decode_slots_paged(params, cache, tables, tokens, pos,
     rows = jnp.arange(b)
 
     def layer_step(x, inputs):
-        p, k_cache, v_cache = inputs
+        p, kv_l = inputs
 
         def write(kn, vn):
             return _scatter_token_kv(
-                k_cache, v_cache, kn[:, :, 0, :], vn[:, :, 0, :],
+                kv_l, kn[:, :, 0, :], vn[:, :, 0, :],
                 tables, rows, pos, page_size, cfg.max_seq)
 
-        def view(kc, vc):
-            return (_gather_pages(kc, tables, cfg),
-                    _gather_pages(vc, tables, cfg))
+        def view(kv):
+            return _gather_pages(kv, tables)
 
-        x, k2, v2 = _cache_layer_step(x, p, cfg, positions, kv_mask,
-                                      write, view)
-        return x, (k2, v2)
+        x, kv2 = _paged_layer_step(x, p, cfg, positions, kv_mask,
+                                   write, view, rules)
+        return x, kv2
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_step, x, (params["blocks"], cache["k"], cache["v"]))
-    return _lm_head(x[:, 0], params, cfg), {"k": new_k, "v": new_v}
+    x, new_kv = jax.lax.scan(
+        layer_step, x, (params["blocks"], cache["kv"]))
+    return _lm_head(x[:, 0], params, cfg), {"kv": new_kv}
 
 
 def prefill_chunk_paged(params, cache, tables, tokens, slot, p0, n_valid,
-                        cfg: LlamaConfig, page_size: int):
+                        cfg: LlamaConfig, page_size: int, rules=None):
     """``prefill_chunk`` over a paged cache: write one C-token prompt
     chunk into ``slot``'s pages (chunk may straddle page boundaries —
     each token's physical destination is computed independently) and
@@ -562,7 +652,6 @@ def prefill_chunk_paged(params, cache, tables, tokens, slot, p0, n_valid,
     chunk-tail garbage never lands in a real page regardless of how the
     chunk aligns to pages. Returns ([vocab] logits of chunk index
     n_valid - 1, new_cache)."""
-    h, hd, hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
     c = tokens.shape[0]
     p = tables.shape[1]
     x = params["wte"][tokens].astype(cfg.dtype)[None]  # [1,C,D]
@@ -578,35 +667,32 @@ def prefill_chunk_paged(params, cache, tables, tokens, slot, p0, n_valid,
     slot_table = jax.lax.dynamic_slice(tables, (slot, 0), (1, p))
 
     def layer_step(x, inputs):
-        pr, k_cache, v_cache = inputs
+        pr, kv_l = inputs
 
         def write(kn, vn):
             # kn/vn: [1, Hkv, C, hd] -> per-token scatter [C, Hkv, hd]
-            return (k_cache.at[phys, :, off, :].set(
-                        kn[0].transpose(1, 0, 2)),
-                    v_cache.at[phys, :, off, :].set(
-                        vn[0].transpose(1, 0, 2)))
+            return kv_l.at[:, phys, off].set(
+                jnp.stack([kn[0].transpose(1, 0, 2),
+                           vn[0].transpose(1, 0, 2)]))
 
-        def view(kc, vc):
-            return (_gather_pages(kc, slot_table, cfg),
-                    _gather_pages(vc, slot_table, cfg))
+        def view(kv):
+            return _gather_pages(kv, slot_table)
 
-        x, k2, v2 = _cache_layer_step(x, pr, cfg, positions, kv_mask,
-                                      write, view)
-        return x, (k2, v2)
+        x, kv2 = _paged_layer_step(x, pr, cfg, positions, kv_mask,
+                                   write, view, rules)
+        return x, kv2
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_step, x, (params["blocks"], cache["k"], cache["v"]))
+    x, new_kv = jax.lax.scan(
+        layer_step, x, (params["blocks"], cache["kv"]))
     row = jax.lax.dynamic_index_in_dim(x[0], n_valid - 1, 0,
                                        keepdims=False)
-    return (_lm_head(row[None], params, cfg)[0],
-            {"k": new_k, "v": new_v})
+    return _lm_head(row[None], params, cfg)[0], {"kv": new_kv}
 
 
 def decode_slots_with_prefill_paged(params, cache, tables, tokens, pos,
                                     pre_tokens, pre_slot, pre_p0,
                                     pre_n_valid, cfg: LlamaConfig,
-                                    page_size: int):
+                                    page_size: int, rules=None):
     """Fused continuous-batching step over the PAGED cache — the paged
     twin of ``decode_slots_with_prefill``: B decode tokens and one
     C-token prefill chunk share every weight matmul; only attention and
@@ -640,7 +726,7 @@ def decode_slots_with_prefill_paged(params, cache, tables, tokens, pos,
     slot_table = jax.lax.dynamic_slice(tables, (pre_slot, 0), (1, p))
 
     def layer_step(x, inputs):
-        pr, k_cache, v_cache = inputs
+        pr, kv_l = inputs
         y = rms_norm(x, pr["attn_norm"])
         t = b + c
         q = (y @ pr["wq"].astype(y.dtype)).reshape(1, t, h, hd).transpose(
@@ -651,6 +737,9 @@ def decode_slots_with_prefill_paged(params, cache, tables, tokens, pos,
             1, t, hkv, hd).transpose(0, 2, 1, 3)
         q = rope(q, positions, cfg.rope_theta)
         k_new = rope(k_new, positions, cfg.rope_theta)
+        q = constrain(q, (None, "heads", None, None), rules)
+        k_new = constrain(k_new, (None, "kv", None, None), rules)
+        v_new = constrain(v_new, (None, "kv", None, None), rules)
         qd = q[0, :, :b].transpose(1, 0, 2)[:, :, None, :]  # [B,h,1,hd]
         kd = k_new[0, :, :b].transpose(1, 0, 2)             # [B,Hkv,hd]
         vd = v_new[0, :, :b].transpose(1, 0, 2)
@@ -659,41 +748,41 @@ def decode_slots_with_prefill_paged(params, cache, tables, tokens, pos,
         vp = v_new[0, :, b:].transpose(1, 0, 2)
         # Writes first, decode rows then the chunk (disjoint pages by
         # the caller's pre_slot guarantee), so in-chunk causality holds.
-        k_cache, v_cache = _scatter_token_kv(
-            k_cache, v_cache, kd, vd, tables, rows, pos, page_size,
-            s_max)
-        k_cache = k_cache.at[phys_c, :, off_c, :].set(kp)
-        v_cache = v_cache.at[phys_c, :, off_c, :].set(vp)
-        od = _gqa_cache_attention(
-            qd, _gather_pages(k_cache, tables, cfg),
-            _gather_pages(v_cache, tables, cfg), dec_mask, cfg)
-        op = _gqa_cache_attention(
-            qp, _gather_pages(k_cache, slot_table, cfg),
-            _gather_pages(v_cache, slot_table, cfg), pre_mask, cfg)
+        kv_l = _scatter_token_kv(kv_l, kd, vd, tables, rows, pos,
+                                 page_size, s_max)
+        kv_l = kv_l.at[:, phys_c, off_c].set(jnp.stack([kp, vp]))
+        kv_l = constrain(kv_l, PAGED_KV_AXES[1:], rules)
+        kv_axes = (None, None, None, "kv", None)
+        od = _gqa_paged_attention(
+            qd, constrain(_gather_pages(kv_l, tables), kv_axes, rules),
+            dec_mask, cfg)
+        op = _gqa_paged_attention(
+            qp, constrain(_gather_pages(kv_l, slot_table), kv_axes,
+                          rules),
+            pre_mask, cfg)
         o = jnp.concatenate([od[:, 0][None], op], axis=1)  # [1,B+C,D]
         x = x + o @ pr["wo"].astype(o.dtype)
         y = rms_norm(x, pr["ffn_norm"])
         gate = jax.nn.silu(y @ pr["w_gate"].astype(y.dtype))
         up = y @ pr["w_up"].astype(y.dtype)
-        x = x + (gate * up) @ pr["w_down"].astype(y.dtype)
-        return x, (k_cache, v_cache)
+        hidden = constrain(gate * up, (None, None, "mlp"), rules)
+        x = x + hidden @ pr["w_down"].astype(y.dtype)
+        return x, kv_l
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_step, x, (params["blocks"], cache["k"], cache["v"]))
+    x, new_kv = jax.lax.scan(
+        layer_step, x, (params["blocks"], cache["kv"]))
     heads_in = jnp.concatenate(
         [x[0, :b], x[0, b + pre_n_valid - 1][None]], axis=0)  # [B+1, D]
     logits = _lm_head(heads_in, params, cfg)
-    return logits[:b], logits[b], {"k": new_k, "v": new_v}
+    return logits[:b], logits[b], {"kv": new_kv}
 
 
 def copy_pages(cache, src, dst):
     """Device-side page copy (the COW in copy-on-write): physical pages
     ``src[i]`` -> ``dst[i]`` across every layer in one program. src/dst
     [N] int32; jit with the cache donated so the copy is in-place."""
-    return {
-        "k": cache["k"].at[:, dst].set(cache["k"][:, src]),
-        "v": cache["v"].at[:, dst].set(cache["v"][:, src]),
-    }
+    kv = cache["kv"]
+    return {"kv": kv.at[:, :, dst].set(kv[:, :, src])}
 
 
 def generate(params, prompt_tokens, cfg: LlamaConfig, max_new: int = 32,

@@ -148,6 +148,32 @@ def use_mesh(mesh: Mesh):
     return mesh
 
 
+def under_mesh(mesh: Mesh, fn):
+    """Wrap ``fn`` so every call runs with ``mesh`` as BOTH the repo's
+    current mesh (so :func:`constrain` resolves) and the ambient jax
+    mesh (so bare PartitionSpecs inside jit resolve). The standard way
+    to invoke a compiled program whose model code uses logical-axis
+    constraints — used by the sharded train step and the tp-sharded
+    serving engine alike."""
+
+    def _call(target, *args, **kwargs):
+        prev = current_mesh()
+        set_current_mesh(mesh)
+        try:
+            with use_mesh(mesh):
+                return target(*args, **kwargs)
+        finally:
+            set_current_mesh(prev)
+
+    def wrapped(*args, **kwargs):
+        return _call(fn, *args, **kwargs)
+
+    # AOT path (compile checks with abstract inputs, no execution).
+    if hasattr(fn, "lower"):
+        wrapped.lower = lambda *a, **kw: _call(fn.lower, *a, **kw)
+    return wrapped
+
+
 def smap(f, mesh: Mesh, in_specs, out_specs):
     """``shard_map`` with version compat (jax>=0.8 moved it to jax.shard_map
     and renamed check_rep->check_vma)."""

@@ -162,23 +162,9 @@ def build_sharded_train(
 
 
 def _under_mesh(mesh: Mesh, fn):
-    from ..parallel.sharding import set_current_mesh, use_mesh
+    from ..parallel.sharding import under_mesh
 
-    def _call(target, *args, **kwargs):
-        prev = None
-        set_current_mesh(mesh)
-        try:
-            with use_mesh(mesh):
-                return target(*args, **kwargs)
-        finally:
-            set_current_mesh(prev)
-
-    def wrapped(*args, **kwargs):
-        return _call(fn, *args, **kwargs)
-
-    # AOT path (compile checks with abstract inputs, no execution).
-    wrapped.lower = lambda *a, **kw: _call(fn.lower, *a, **kw)
-    return wrapped
+    return under_mesh(mesh, fn)
 
 
 def make_eval_step(loss_fn, mesh: Mesh, rules: Optional[Rules],

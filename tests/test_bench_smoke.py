@@ -78,6 +78,24 @@ def test_bench_smoke_runs_all_stages():
     assert sess["warm_ttft_speedup"] >= 1.5, sess
     assert sess["prefix_tokens_saved"] > 0, sess
 
+    # Long-gen decode + roofline stage (ISSUE 17): sustained decode
+    # tok/s with the decode block committed next to the roofline
+    # fraction, plus the tp2 parity sub-stage — under the test env's
+    # virtual devices it must run and hold bit-for-bit (a single-device
+    # host skips it cleanly instead).
+    assert "llm_longgen_error" not in result, result
+    lg = result["llm_longgen"]
+    assert lg["tokens_per_s_longgen"] > 0, lg
+    assert lg["decode_block"] >= 1, lg
+    assert lg["decode_steps"] > 0, lg
+    assert lg["roofline_frac"] >= 0, lg
+    assert lg["bytes_per_step"] > 0, lg
+    if isinstance(lg.get("tp2"), str):
+        assert lg["tp2"].startswith("skipped"), lg
+    else:
+        assert lg["tp2_token_parity"] is True, lg
+        assert "tp" in lg["tp2_kv_spec"], lg
+
     # Flight-recorder stage (ISSUE 16): per-stage task latency joined
     # head-side with worker exec deltas, stage sums ~= end-to-end, and
     # the LLM half commits per-request timing + the decode roofline

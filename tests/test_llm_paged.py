@@ -94,6 +94,50 @@ def test_radix_match_insert_evict():
 
 # -- kernel parity: paged vs dense programs -----------------------------------
 
+def test_paged_cache_layout_heads_minor():
+    """The page pool is ONE fused array [L, 2, pages, page_size, Hkv,
+    hd] — K and V stacked so the decode gather is a single HBM sweep,
+    heads-minor so a gathered page reshapes to the seq-major attention
+    view without a materializing transpose, and axis 4 carries the
+    'kv' logical axis for tp sharding."""
+    cache = llama.init_paged_kv_cache(CFG, 7, PS)
+    assert set(cache) == {"kv"}
+    assert cache["kv"].shape == (CFG.num_layers, 2, 7, PS,
+                                 CFG.num_kv_heads, CFG.head_dim)
+    # The logical-axis annotation must line up with that shape: exactly
+    # one 'kv' entry, on the heads axis.
+    assert llama.PAGED_KV_AXES == (None, None, None, None, "kv", None)
+
+
+def test_paged_sampled_parity_vs_dense_reference(engine, params):
+    """Seeded sampling through the paged engine must reproduce a dense
+    decode_step loop drawing from the same per-request fold_in stream —
+    pins both the kernel numerics (heads-minor layout) and the sampling
+    position bookkeeping (token j drawn at qpos = prompt_len + j)."""
+    rng = np.random.default_rng(67)
+    prompt = [int(t) for t in rng.integers(1, CFG.vocab_size, size=18)]
+    temperature, seed, max_new = 0.8, 4242, 10
+    # Dense reference: single-row KV cache, one decode_step per token.
+    cache = llama.init_kv_cache(CFG, 1)
+    logits = None
+    for i, t in enumerate(prompt):
+        logits, cache = llama.decode_step(
+            params, cache, jnp.asarray([t], jnp.int32),
+            jnp.asarray(i, jnp.int32), CFG)
+    ref = []
+    for j in range(max_new):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                                 len(prompt) + j)
+        tok = int(jax.random.categorical(key, logits[0] / temperature))
+        ref.append(tok)
+        logits, cache = llama.decode_step(
+            params, cache, jnp.asarray([tok], jnp.int32),
+            jnp.asarray(len(prompt) + j, jnp.int32), CFG)
+    got = run_one(engine, prompt, max_new=max_new,
+                  temperature=temperature, seed=seed)
+    assert got == ref
+
+
 def test_paged_kernels_match_dense(params):
     """prefill_chunk_paged + decode_slots_paged must produce the same
     logits as the dense prefill_chunk + decode_slots for the same

@@ -1,0 +1,125 @@
+"""Mesh-sharded serving (ISSUE 17): a tp-sharded SlotEngine must
+produce bit-for-bit the single-device token stream — params sharded by
+their logical axes, the paged KV pool sharded on its KV-heads axis,
+cache donation surviving under sharding — plus the decode roofline
+profiler's hardening (zero-bandwidth guard, window-reset API)."""
+
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from ray_tpu.llm.engine import SlotEngine
+from ray_tpu.models import llama
+from ray_tpu.parallel.mesh import MeshSpec
+
+CFG = llama.CONFIGS["llama-tiny"]
+PS = 8
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_two = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs >= 2 (virtual) devices")
+
+
+@pytest.fixture(scope="module")
+def params():
+    p, _ = llama.init_params(jax.random.PRNGKey(0), CFG)
+    return p
+
+
+def _drive(eng, prompt, max_new, **kw):
+    h = eng.submit(prompt, max_new=max_new, **kw)
+    for _ in range(4000):
+        if h._done.is_set():
+            return h.result(timeout=0).tokens
+        eng.step()
+    raise AssertionError("engine did not finish")
+
+
+@needs_two
+def test_tp2_token_parity_params_and_pages_sharded(params):
+    """The acceptance criterion: tp1-vs-tp2 bit-for-bit token parity
+    with params AND KV pages actually sharded (verified via sharding
+    specs, not just absence of errors)."""
+    rng = np.random.default_rng(5)
+    prompt = [int(t) for t in rng.integers(1, CFG.vocab_size, size=21)]
+    eng1 = SlotEngine(params, CFG, num_slots=2, chunk=8, page_size=PS,
+                      decode_block=2)
+    mesh = MeshSpec(tp=2).build(jax.devices()[:2])
+    eng2 = SlotEngine(params, CFG, num_slots=2, chunk=8, page_size=PS,
+                      decode_block=2, mesh=mesh)
+    # Placement must actually shard: qkv + mlp params over tp, and the
+    # page pool's KV-heads axis over tp — not silently replicate.
+    assert "tp" in str(eng2._params["blocks"]["wq"].sharding.spec)
+    assert "tp" in str(eng2._params["blocks"]["w_gate"].sharding.spec)
+    assert tuple(eng2._cache["kv"].sharding.spec) == \
+        (None, None, None, None, "tp")
+    # Greedy parity.
+    assert _drive(eng2, prompt, 16) == _drive(eng1, prompt, 16)
+    # Seeded sampling parity: the per-request fold_in stream makes the
+    # draw independent of the mesh, so sampled outputs match too.
+    s1 = _drive(eng1, prompt, 16, temperature=0.7, seed=99)
+    assert _drive(eng2, prompt, 16, temperature=0.7, seed=99) == s1
+    # Donation under sharding: after full requests (many donated
+    # steps), the cache must STILL carry the tp sharding — a silent
+    # reshard-to-replicated would defeat the whole point.
+    assert tuple(eng2._cache["kv"].sharding.spec) == \
+        (None, None, None, None, "tp")
+
+
+@needs_two
+def test_tp_must_divide_head_counts(params):
+    """A mesh whose tp size doesn't divide the KV-head count must be
+    rejected at construction, not fail inside a compiled program."""
+    mesh = MeshSpec(tp=2).build(jax.devices()[:2])
+    bad = llama.LlamaConfig(vocab_size=512, max_seq=128, num_layers=1,
+                            num_heads=3, num_kv_heads=3, d_model=48,
+                            d_mlp=96, dtype=None)
+    p, _ = llama.init_params(jax.random.PRNGKey(0), bad)
+    with pytest.raises(ValueError, match="tp=2 must divide"):
+        SlotEngine(p, bad, num_slots=2, chunk=8, page_size=8, mesh=mesh)
+
+
+def test_decode_profile_guard_and_reset(params):
+    """Satellite hardening: hbm_bandwidth_gbps <= 0 must degrade to
+    roofline_frac 0.0 (not ZeroDivisionError), and reset_decode_profile
+    must zero the window so bench stages measure independently."""
+    from ray_tpu.core.config import config
+
+    eng = SlotEngine(params, CFG, num_slots=2, chunk=8, page_size=PS,
+                     decode_block=2)
+    eng.warmup()
+    handles = [eng.submit([1, 2, 3, 4, 5], max_new=12) for _ in range(2)]
+    for _ in range(4000):
+        if all(h._done.is_set() for h in handles):
+            break
+        eng.step()
+    prof = eng.decode_profile()
+    assert prof["steps"] > 0 and prof["roofline_frac"] > 0
+    assert prof["devices"] == 1
+    cfg_obj = config()
+    old = cfg_obj.hbm_bandwidth_gbps
+    try:
+        cfg_obj.apply_overrides({"hbm_bandwidth_gbps": 0.0})
+        guarded = eng.decode_profile()  # must not raise
+        assert guarded["roofline_frac"] == 0.0
+        assert guarded["steps"] == prof["steps"]
+    finally:
+        cfg_obj.apply_overrides({"hbm_bandwidth_gbps": old})
+    eng.reset_decode_profile()
+    zeroed = eng.decode_profile()
+    assert zeroed["steps"] == 0 and zeroed["roofline_frac"] == 0.0
+
+
+@pytest.mark.slow
+@needs_two
+def test_multichip_serving_dryrun_stage():
+    """The multichip dryrun's serving stage end-to-end (slow: compiles
+    the engine twice). The dryrun prints the parity line that lands in
+    the MULTICHIP_*.json stdout tail."""
+    sys.path.insert(0, REPO)
+    import __graft_entry__ as g
+
+    g._dryrun_llm_serving_tp(jax.devices())
