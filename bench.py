@@ -197,6 +197,7 @@ def main():
     for key, fn_name in (("core_microbench", "bench_core"),
                          ("serve_bench", "bench_serve"),
                          ("serve_mixed", "bench_serve_mixed"),
+                         ("serve_chaos", "bench_serve_chaos"),
                          ("envelope", "bench_envelope"),
                          ("ring_parity", "bench_ring_parity"),
                          ("head_failover", "bench_head_failover")):
@@ -513,9 +514,9 @@ def bench_serve(smoke: bool = False) -> dict:
             # Fresh controller snapshot each poll — the router's local
             # set only grows via its long-poll listener and its
             # _ensure_replicas early-returns once non-empty.
-            _, replicas = rt.get(
+            replicas = rt.get(
                 _controller().get_replica_snapshot.remote(
-                    f"noop{n_replicas}"), timeout=30)
+                    f"noop{n_replicas}"), timeout=30)[1]
             if len(replicas) >= n_replicas:
                 break
             time.sleep(0.5)
@@ -783,6 +784,201 @@ def bench_serve_mixed(smoke: bool = False) -> dict:
                 "mix"]["num_replicas"]
         except Exception:
             pass
+    finally:
+        serve.shutdown()
+    return out
+
+
+def bench_serve_chaos(smoke: bool = False) -> dict:
+    """Chaos stage (fault tolerance): sustained HTTP + handle traffic
+    against a replicated deployment while a ReplicaKiller SIGKILLs
+    replica workers mid-wave. The contract under fire: every request
+    ends as a success, a typed 503, or a typed deadline error — never a
+    hang and never a raw 500. Reports replacement latency (SIGKILL ->
+    controller evicts the corpse and reconciliation brings a fresh
+    replica up) and the p99 of requests completing during kill windows.
+    Full mode also SIGKILLs a daemon node mid-traffic."""
+    import http.client
+    import socket
+    import threading
+
+    import ray_tpu as rt
+    from ray_tpu import serve
+    from ray_tpu.cluster_utils import ReplicaKiller
+    from ray_tpu.core import runtime as runtime_mod
+    from ray_tpu.core.exceptions import (DeadlineExceededError,
+                                         GetTimeoutError, OverloadedError,
+                                         TaskError)
+
+    rt.init(ignore_reinit_error=True, num_cpus=4)
+    port = 18241
+    serve.start(http_port=port)
+    fast = smoke and os.environ.get("BENCH_SMOKE_FAST") == "1"
+    n_replicas = 2 if smoke else 3
+    kills_planned = 1 if smoke else 3
+    n_http = 1 if smoke else 2
+    n_handle = 1 if smoke else 2
+    out = {"replicas": n_replicas, "kills_planned": kills_planned}
+
+    @serve.deployment(name="chaos", num_replicas=n_replicas,
+                      max_concurrent_queries=32, max_pending=256,
+                      queue_timeout_s=5.0, request_deadline_s=10.0,
+                      health_check_period_s=0.25,
+                      health_check_timeout_s=1.0,
+                      health_check_failure_threshold=2)
+    async def chaos(payload=None):
+        import asyncio
+
+        await asyncio.sleep(0.002)
+        return {"ok": True}
+
+    counts = {"ok": 0, "typed_503": 0, "deadline": 0, "raw_500": 0,
+              "other": 0, "hung": 0}
+    lats_ms = []
+    during_ms = []
+    kill_window = [False]
+    stop = [time.perf_counter() + 120.0]
+    lock = threading.Lock()
+
+    def note(kind, t0=None):
+        with lock:
+            counts[kind] += 1
+            if t0 is not None:
+                ms = (time.perf_counter() - t0) * 1000
+                lats_ms.append(ms)
+                if kill_window[0]:
+                    during_ms.append(ms)
+
+    def http_client(i):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            while time.perf_counter() < stop[0]:
+                t0 = time.perf_counter()
+                try:
+                    conn.request("GET", "/chaos")
+                    resp = conn.getresponse()
+                    body = resp.read()
+                except socket.timeout:
+                    note("hung")
+                    break
+                except Exception:  # conn dropped: reconnect, count it
+                    note("other")
+                    conn.close()
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", port, timeout=30)
+                    continue
+                if resp.status == 200:
+                    note("ok", t0)
+                elif resp.status == 503 and b"overloaded" in body:
+                    note("typed_503", t0)
+                elif resp.status == 504 and b"deadline" in body:
+                    note("deadline", t0)
+                elif resp.status >= 500:
+                    note("raw_500")
+                else:
+                    note("other")
+        finally:
+            conn.close()
+
+    def handle_client(i, handle):
+        while time.perf_counter() < stop[0]:
+            t0 = time.perf_counter()
+            try:
+                rt.get(handle.remote(), timeout=30)
+                note("ok", t0)
+            except GetTimeoutError:
+                note("hung")
+                break
+            except Exception as e:  # noqa: BLE001
+                root = e
+                while isinstance(root, TaskError) and root.cause is not None:
+                    root = root.cause
+                if isinstance(root, OverloadedError):
+                    note("typed_503", t0)
+                elif isinstance(root, DeadlineExceededError):
+                    note("deadline", t0)
+                else:
+                    note("other")
+
+    replaced_ms = []
+    notes = []
+    try:
+        handle = serve.run(chaos.bind())
+        rt.get(handle.remote(), timeout=60)
+        warm = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        for _ in range(5):
+            warm.request("GET", "/chaos")
+            warm.getresponse().read()
+        warm.close()
+
+        threads = ([threading.Thread(target=http_client, args=(i,))
+                    for i in range(n_http)]
+                   + [threading.Thread(target=handle_client,
+                                       args=(i, handle))
+                      for i in range(n_handle)])
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(0.3 if fast else 0.6)  # traffic established
+
+        killer = ReplicaKiller("chaos")
+        for _k in range(kills_planned):
+            t_kill = time.perf_counter()
+            victim = killer.kill_one()
+            if victim is None:
+                notes.append("no killable replica")
+                continue
+            kill_window[0] = True
+            # Replacement = corpse evicted AND target count restored
+            # with live worker pids (health sweep + reconciliation).
+            while time.perf_counter() - t_kill < 30.0:
+                pids = killer.replica_pids()
+                if victim not in pids and len(pids) >= n_replicas:
+                    replaced_ms.append(
+                        (time.perf_counter() - t_kill) * 1000)
+                    break
+                time.sleep(0.01)
+            else:
+                notes.append("replacement timed out (30s)")
+            kill_window[0] = False
+            time.sleep(0.2 if fast else 0.4)
+
+        if not smoke:
+            # Daemon-death phase: SIGKILL a remote-node daemon process
+            # mid-traffic; serve traffic on head-local replicas must be
+            # unaffected and the runtime must absorb the node loss.
+            try:
+                runtime = runtime_mod.get_head_runtime()
+                node_id = runtime.add_node({"CPU": 1.0}, remote=True)
+                time.sleep(0.5)
+                node = runtime.scheduler.get_node(node_id)
+                if node is not None and getattr(node, "is_remote", False):
+                    node.process.kill()
+                    out["daemon_killed"] = True
+                    time.sleep(1.0)
+                else:
+                    notes.append("daemon node not remote; skipped")
+            except Exception as e:  # noqa: BLE001
+                notes.append(f"daemon phase skipped: {e!r}"[:200])
+
+        stop[0] = time.perf_counter() + (0.3 if fast else 0.6)  # tail
+        for t in threads:
+            t.join(timeout=45)
+        with lock:
+            counts["hung"] += sum(1 for t in threads if t.is_alive())
+        elapsed = time.perf_counter() - t0
+        out["duration_s"] = round(elapsed, 2)
+        out["kills"] = len(killer.killed)
+        out["counts"] = dict(counts)
+        pr = percentiles(replaced_ms)
+        out["replaced_ms_p50"] = pr["p50"]
+        out["replaced_ms_p99"] = pr["p99"]
+        out["during_kill_p99_ms"] = (percentiles(during_ms)["p99"]
+                                     if during_ms else 0.0)
+        out.update({"req_" + k: v for k, v in
+                    percentiles(lats_ms, unit="ms").items()})
+        if notes:
+            out["notes"] = notes[:5]
     finally:
         serve.shutdown()
     return out
@@ -1476,6 +1672,13 @@ def smoke() -> dict:
         result["serve_mixed"] = bench_serve_mixed(smoke=True)
     except Exception as e:  # noqa: BLE001
         result["serve_mixed_error"] = repr(e)[:300]
+    # Fault-tolerance chaos stage: replica SIGKILL under live traffic —
+    # zero hung / raw-500 requests and bounded replacement latency are
+    # asserted by the smoke test so the recovery path can't bitrot.
+    try:
+        result["serve_chaos"] = bench_serve_chaos(smoke=True)
+    except Exception as e:  # noqa: BLE001
+        result["serve_chaos_error"] = repr(e)[:300]
     # Paged-KV multi-turn session stage: warm turns must beat cold ones
     # on TTFT via the radix prefix cache (asserted by the smoke test so
     # the scenario — and the cache — can't bitrot).

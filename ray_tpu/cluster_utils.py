@@ -150,6 +150,98 @@ class NodeKiller:
             self._thread = None
 
 
+class ReplicaKiller:
+    """Chaos fault injector for the SERVE plane: SIGKILLs a random
+    replica worker of one deployment on a timer (sibling of
+    :class:`NodeKiller` / :class:`HeadKiller`).
+
+    A replica dies like a real worker crash — no cooperative teardown,
+    the head notices via pipe EOF, the controller's health sweep /
+    death path evicts it, and target-count reconciliation replaces it.
+    Used by ``bench_serve_chaos`` and the fault-tolerance tests to
+    prove requests in flight on the victim are retried (or fail with a
+    typed error), never hung.
+    """
+
+    def __init__(self, deployment: str, kill_interval_s: float = 1.0,
+                 max_kills: Optional[int] = None, seed: int = 0):
+        import random
+        import threading
+
+        self.deployment = deployment
+        self.kill_interval_s = kill_interval_s
+        self.max_kills = max_kills
+        self.killed: list = []  # (actor_id, pid) per kill
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _replicas(self) -> list:
+        from .serve import api as serve_api
+
+        ctrl = serve_api._controller()
+        if ctrl is None:
+            return []
+        rt = runtime_mod.get_head_runtime()
+        return rt.get(ctrl.get_replicas.remote(self.deployment),
+                      timeout=10)
+
+    def replica_pids(self) -> Dict[bytes, int]:
+        """actor_id bytes -> worker pid for the deployment's live
+        replicas (skips replicas whose worker is gone already)."""
+        rt = runtime_mod.get_head_runtime()
+        out: Dict[bytes, int] = {}
+        for r in self._replicas():
+            rec = rt.get_actor_record(r._actor_id)
+            worker = getattr(rec, "worker", None)
+            proc = getattr(worker, "process", None)
+            pid = getattr(proc, "pid", None)
+            if pid is not None:
+                out[r._actor_id.binary()] = pid
+        return out
+
+    def kill_one(self) -> Optional[bytes]:
+        """SIGKILL one random replica worker now; returns the victim's
+        actor_id bytes (or None if no killable replica exists)."""
+        import os
+        import signal
+
+        pids = self.replica_pids()
+        if not pids:
+            return None
+        victim = self._rng.choice(sorted(pids))
+        pid = pids[victim]
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            return None
+        self.killed.append((victim, pid))
+        return victim
+
+    def run(self) -> None:
+        import threading
+
+        def loop():
+            while not self._stop.wait(self.kill_interval_s):
+                if (self.max_kills is not None
+                        and len(self.killed) >= self.max_kills):
+                    return
+                try:
+                    self.kill_one()
+                except Exception:
+                    pass  # serve shutting down mid-chaos is fine
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="rt-replica-killer")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
 # Driver script run by each HeadKiller head process. Cycle 1 creates the
 # named chaos actor; every later cycle is a RECOVERY: the replacement
 # head replays the WAL during init, the actor re-resolves by name, and

@@ -90,6 +90,31 @@ class GetTimeoutError(RuntimeError_, TimeoutError):
     """``get(..., timeout=)`` expired before the object was ready."""
 
 
+class OverloadedError(RuntimeError_):
+    """Typed admission-shed error: a bounded pending queue is full or a
+    request waited past the queue timeout. The HTTP proxy maps it to a
+    503 so clients can back off instead of reading a generic 500.
+
+    Shared across planes (serve router admission, LLM engine admission —
+    re-exported from ``llm.paged`` for compat) so the proxy can match it
+    by ``isinstance`` instead of class-name string matching.
+    """
+
+
+class DeadlineExceededError(RuntimeError_, TimeoutError):
+    """A request's end-to-end deadline expired (queueing, retries, and
+    handler execution included). Serve propagates the per-request
+    deadline proxy -> router -> replica; the proxy maps this to 504."""
+
+
+class StreamInterruptedError(RuntimeError_):
+    """A streaming response died after its first chunk was delivered.
+
+    Past the first byte a retry could duplicate already-delivered
+    output, so the serve plane fails fast with this typed error instead
+    of re-dispatching."""
+
+
 class TaskCancelledError(RuntimeError_):
     """The task was cancelled before or during execution."""
 

@@ -67,6 +67,11 @@ class _ObjectEntry:
     # one-shot callbacks fired (outside the lock) on READY/FAILED — the
     # async wait/watch path; unlike futures these don't materialize values
     watchers: List = field(default_factory=list)
+    # one-shot hook consulted BEFORE a failure is finalized (serve-plane
+    # safe retry): fn(error) -> True takes ownership of completing the
+    # oid later, so futures/watchers stay parked instead of seeing the
+    # transient error. See Runtime.intercept_failure.
+    failure_interceptor: Optional[Callable] = None
 
 
 @dataclass
@@ -586,6 +591,23 @@ class Runtime:
 
     def _mark_failed(self, oid: ObjectID, error: Exception) -> None:
         with self._lock:
+            icept_entry = self._objects.setdefault(oid, _ObjectEntry())
+            icept = icept_entry.failure_interceptor
+            icept_entry.failure_interceptor = None
+        if icept is not None:
+            # Consulted OUTSIDE the finalization: an accepting
+            # interceptor (serve router re-dispatching to a healthy
+            # replica) suppresses the failure entirely — the oid stays
+            # PENDING and is completed later via transfer_result /
+            # fail_object. The hook must not block (it spawns its retry
+            # work on another thread): some _mark_failed callers hold
+            # the runtime RLock.
+            try:
+                if icept(error):
+                    return
+            except Exception:  # noqa: BLE001 — a broken hook must not
+                pass  # suppress the underlying failure
+        with self._lock:
             entry = self._objects.setdefault(oid, _ObjectEntry())
             entry.status = _ObjStatus.FAILED
             entry.error = error
@@ -630,6 +652,99 @@ class Runtime:
                 entry.watchers.append(callback)
                 return
         callback()
+
+    # ------------------------------------------------- serve-plane safe retry
+    # The serve router retries actor-death failures by re-dispatching the
+    # request to a healthy replica while the CALLER keeps waiting on the
+    # original ObjectRef. These four hooks make that possible without any
+    # cost on the success path: a one-shot failure interceptor parks the
+    # failure, and the retry loop later completes the original oid from a
+    # fresh attempt's result (transfer_result) or finalizes the error
+    # (fail_object).
+
+    def intercept_failure(self, oid: ObjectID, fn) -> None:
+        """Register a one-shot hook consulted before ``oid`` is failed.
+
+        ``fn(error) -> bool``: returning True takes ownership — the
+        failure is suppressed, futures/watchers stay parked, and the
+        caller must later finish the oid via :meth:`transfer_result` or
+        :meth:`fail_object`. Must not block (may run under the runtime
+        lock).
+
+        If the oid has ALREADY failed (actor-death fast path: submitting
+        to a DEAD actor fails return oids before the caller can register
+        a hook), ``fn`` is consulted immediately; on acceptance the
+        entry is revived to PENDING — safe here because the router
+        registers before handing the ref to any waiter.
+        """
+        with self._lock:
+            entry = self._objects.setdefault(oid, _ObjectEntry())
+            if entry.status != _ObjStatus.FAILED:
+                entry.failure_interceptor = fn
+                return
+            error = entry.error
+        try:
+            accepted = bool(fn(error))
+        except Exception:  # noqa: BLE001
+            accepted = False
+        if accepted:
+            with self._lock:
+                entry = self._objects.setdefault(oid, _ObjectEntry())
+                if entry.status == _ObjStatus.FAILED:
+                    entry.status = _ObjStatus.PENDING
+                    entry.error = None
+
+    def fail_object(self, oid: ObjectID, error: Exception) -> None:
+        """Finalize ``oid`` as failed (retry budget / deadline exhausted).
+
+        Public wrapper over the normal failure path, so any interceptor
+        registered since is honored too."""
+        self._mark_failed(oid, error)
+
+    def object_status(self, oid: ObjectID):
+        """``(status_name, error)`` snapshot for an object id."""
+        with self._lock:
+            entry = self._objects.get(oid)
+            if entry is None:
+                return ("unknown", None)
+            return (entry.status.lower(), entry.error)
+
+    def transfer_result(self, src_oid: ObjectID, dst_oid: ObjectID) -> None:
+        """Complete ``dst_oid`` with the outcome of READY/FAILED ``src_oid``.
+
+        Used by the retry loop: the fresh attempt's return object becomes
+        the original request's result. Copies the serialized frame (no
+        deserialize round-trip) so large payloads stay one memcpy."""
+        with self._lock:
+            entry = self._objects.get(src_oid)
+            status = entry.status if entry is not None else None
+            error = entry.error if entry is not None else None
+            location = entry.location if entry is not None else None
+        if status == _ObjStatus.FAILED:
+            self._mark_failed(dst_oid, error)
+            return
+        if status != _ObjStatus.READY:
+            self._mark_failed(dst_oid, ObjectLostError(
+                src_oid, f"transfer_result: source object "
+                         f"{src_oid.hex()[:8]} not ready ({status})"))
+            return
+        try:
+            if location[0] == "memory":
+                frame = self.memory_store.get(src_oid)
+                if frame is None:
+                    raise ObjectLostError(src_oid)
+            else:
+                _, node_id, _size = location
+                node = self.scheduler.get_node(node_id)
+                if node is None:
+                    raise ObjectLostError(
+                        src_oid, f"node {node_id.hex()[:8]} holding "
+                                 f"retried result is gone")
+                frame = self._store_read_bytes(node.store, src_oid)
+        except Exception as e:  # noqa: BLE001
+            self._mark_failed(dst_oid, e)
+            return
+        self._store_frame(dst_oid, frame)
 
     def object_future(self, ref: ObjectRef) -> Future:
         if self._submit_buf:

@@ -32,10 +32,10 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 
-class OverloadedError(RuntimeError):
-    """Typed admission-shed error: the pending queue is full or a
-    request waited past the queue timeout. The HTTP proxy maps it to a
-    503 so clients can back off instead of reading a generic 500."""
+# Shared typed admission-shed error (moved to core.exceptions so the
+# serve proxy can isinstance-check it across planes); re-exported here
+# for compat with existing `from .paged import OverloadedError` imports.
+from ..core.exceptions import OverloadedError  # noqa: F401,E402
 
 
 class PagePool:

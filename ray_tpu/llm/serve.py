@@ -172,6 +172,12 @@ def build_llm_app(model: str = "llama-tiny", num_slots: int = 8,
     """Build a Serve application for ``serve.run`` hosting the engine."""
     from ..serve import deployment
 
+    # Mirror the engine's admission knobs into the deployment config so
+    # the router sheds at the same bound BEFORE a request crosses into
+    # the replica (the engine's own bounded queue stays authoritative
+    # for in-replica admission).
+    deploy_opts.setdefault("max_pending", max_pending)
+    deploy_opts.setdefault("queue_timeout_s", queue_timeout_s)
     dep = deployment(LLMServer, name=name, **deploy_opts)
     return dep.bind(model=model, num_slots=num_slots, chunk=chunk,
                     seed=seed, checkpoint_path=checkpoint_path,

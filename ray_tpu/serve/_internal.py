@@ -26,6 +26,12 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..core import get, kill, remote, wait
 from ..core.actor import ActorHandle
+from ..core.exceptions import (
+    ActorError,
+    DeadlineExceededError,
+    OverloadedError,
+    WorkerCrashedError,
+)
 
 # -- first-class Serve metrics (reference: serve/_private/metrics_utils +
 # the serve_* series of metric_defs.cc). Created lazily in whichever
@@ -75,6 +81,21 @@ def serve_metrics() -> Optional[Dict[str, Any]]:
                 "replicas": get_or_create(
                     Gauge, "rt_serve_replicas",
                     "Live replicas per deployment", ("deployment",)),
+                "restarts": get_or_create(
+                    Counter, "rt_serve_replica_restarts_total",
+                    "Replicas replaced after failed health checks",
+                    ("deployment",)),
+                "retries": get_or_create(
+                    Counter, "rt_serve_retries_total",
+                    "Requests re-dispatched after replica death",
+                    ("deployment", "reason")),
+                "unhealthy": get_or_create(
+                    Gauge, "rt_serve_unhealthy_replicas",
+                    "Replicas currently failing health checks",
+                    ("deployment",)),
+                "deadline_exceeded": get_or_create(
+                    Counter, "rt_serve_deadline_exceeded_total",
+                    "Requests that exceeded their end-to-end deadline"),
             }
         return _serve_metrics_cache
 
@@ -86,6 +107,22 @@ def serve_metrics() -> Optional[Dict[str, Any]]:
 # stays on each router's own condvar.
 _qd_lock = threading.Lock()
 _qd_totals: Dict[str, int] = {}
+
+# Deployment-wide BLOCKED-waiter totals (cluster-wide admission): when a
+# deployment sets max_pending, an assign that would queue past the bound
+# is shed with a typed OverloadedError instead of joining the condvar
+# wait. Shares _qd_lock — both are two-instruction critical sections.
+_pending_totals: Dict[str, int] = {}
+
+
+def _pending_note(name: str, delta: int) -> int:
+    """Update (delta != 0) or read (delta == 0) the deployment's blocked
+    assign count across every router in this process."""
+    with _qd_lock:
+        total = max(0, _pending_totals.get(name, 0) + delta)
+        if delta:
+            _pending_totals[name] = total
+        return total
 
 
 def _queue_depth_note(name: str, delta: int, gauge=None,
@@ -127,6 +164,38 @@ class DeploymentInfo:
     version: int = 0
     request_timeout_s: Optional[float] = None
     user_config: Optional[dict] = None
+    # -- fault tolerance / admission (ISSUE 18) --------------------------
+    # End-to-end deadline per request (queueing + retries + handler);
+    # None = no deadline beyond request_timeout_s per attempt.
+    request_deadline_s: Optional[float] = None
+    # Safe-retry budget for requests that die with the replica BEFORE
+    # any response byte; 0 disables. Non-idempotent deployments fail
+    # fast with the typed actor error instead of re-dispatching.
+    max_request_retries: int = 2
+    retry_backoff_s: float = 0.05
+    idempotent: bool = True
+    # Cluster-wide admission: bound on blocked (queued) assigns across
+    # every router of this deployment, and how long a queued request may
+    # wait for a slot before being shed as OverloadedError -> HTTP 503.
+    max_pending: Optional[int] = None
+    queue_timeout_s: Optional[float] = None
+    # Controller liveness probes: period between probes, per-probe
+    # timeout, and consecutive failures before the replica is evicted
+    # and replaced. None period disables health checking.
+    health_check_period_s: Optional[float] = 1.0
+    health_check_timeout_s: float = 5.0
+    health_check_failure_threshold: int = 3
+
+
+def _err_payload(e: BaseException):
+    """Per-item batch error payload. Errors are stringified for
+    transport (arbitrary app exceptions may not pickle) EXCEPT the typed
+    control-flow errors the proxy must isinstance-match — admission
+    sheds (-> 503) and deadline expiry (-> 504) — which are
+    known-picklable and travel as live exceptions."""
+    if isinstance(e, (OverloadedError, DeadlineExceededError)):
+        return e
+    return repr(e)
 
 
 class _Replica:
@@ -213,28 +282,45 @@ class _Replica:
         self._streams[self._stream_counter] = (gen, time.monotonic())
         return ("__rt_stream__", self._stream_counter)
 
-    async def _invoke(self, fn, args, kwargs):
+    def _limit(self, timeout_s: Optional[float]) -> Optional[float]:
+        """Effective per-attempt timeout: the deployment's
+        request_timeout_s bounded by the request's remaining deadline
+        (propagated proxy -> router -> replica). None = unbounded."""
+        if timeout_s is None:
+            return self._timeout
+        if self._timeout is None:
+            return timeout_s
+        return min(self._timeout, timeout_s)
+
+    async def _invoke(self, fn, args, kwargs,
+                      timeout_s: Optional[float] = None):
         import asyncio
         import functools
         import inspect
 
-        target = self._resolve_target(fn)
-        if inspect.iscoroutinefunction(target):
-            coro = fn(*args, **kwargs)
-            result = await (asyncio.wait_for(coro, self._timeout)
-                            if self._timeout else coro)
-        else:
-            # Sync handlers run off-loop so concurrent requests (e.g.
-            # @serve.batch coalescing) aren't serialized behind the
-            # replica's event loop.
-            loop = asyncio.get_running_loop()
-            call = loop.run_in_executor(
-                None, functools.partial(fn, *args, **kwargs))
-            result = await (asyncio.wait_for(call, self._timeout)
-                            if self._timeout else call)
-            if inspect.iscoroutine(result):
-                result = await (asyncio.wait_for(result, self._timeout)
-                                if self._timeout else result)
+        limit = self._limit(timeout_s)
+        try:
+            target = self._resolve_target(fn)
+            if inspect.iscoroutinefunction(target):
+                coro = fn(*args, **kwargs)
+                result = await (asyncio.wait_for(coro, limit)
+                                if limit else coro)
+            else:
+                # Sync handlers run off-loop so concurrent requests (e.g.
+                # @serve.batch coalescing) aren't serialized behind the
+                # replica's event loop.
+                loop = asyncio.get_running_loop()
+                call = loop.run_in_executor(
+                    None, functools.partial(fn, *args, **kwargs))
+                result = await (asyncio.wait_for(call, limit)
+                                if limit else call)
+                if inspect.iscoroutine(result):
+                    result = await (asyncio.wait_for(result, limit)
+                                    if limit else result)
+        except asyncio.TimeoutError:
+            raise DeadlineExceededError(
+                f"request exceeded its deadline ({limit:.3f}s) in "
+                f"deployment {self._deployment!r}") from None
         if inspect.isgenerator(result) or inspect.isasyncgen(result):
             return self._register_stream(result)
         return result
@@ -259,7 +345,8 @@ class _Replica:
             except Exception:
                 pass
 
-    async def handle_request(self, args, kwargs):
+    async def handle_request(self, args, kwargs,
+                             timeout_s: Optional[float] = None):
         # Sweep abandoned streams from the request path too: a replica
         # whose LAST streaming consumer disconnected would otherwise
         # leak that generator until another streaming request arrives.
@@ -273,7 +360,7 @@ class _Replica:
             fn = self.callable
             if not callable(fn):
                 raise TypeError("deployment is not callable")
-            return await self._invoke(fn, args, kwargs)
+            return await self._invoke(fn, args, kwargs, timeout_s)
         except BaseException:
             ok = False
             raise
@@ -281,7 +368,8 @@ class _Replica:
             self._observe(start, 1, ok)
             self._ongoing -= 1
 
-    async def handle_request_batch(self, items):
+    async def handle_request_batch(self, items,
+                                   timeout_s: Optional[float] = None):
         """Coalesced entry: N requests in ONE actor RPC (the proxy's
         Nagle-style batching — on a host where the per-call actor hop is
         the serving bottleneck, coalescing divides it by the batch).
@@ -303,6 +391,7 @@ class _Replica:
         self._ongoing += len(items)
         self._total += len(items)
         start = time.perf_counter()
+        limit = self._limit(timeout_s)
         out = None
         try:
             fn = self.callable
@@ -311,9 +400,10 @@ class _Replica:
                 async def one(args, kwargs):
                     try:
                         return ("ok", await self._invoke(fn, args,
-                                                         kwargs))
+                                                         kwargs,
+                                                         timeout_s))
                     except Exception as e:  # noqa: BLE001 — isolation
-                        return ("err", repr(e))
+                        return ("err", _err_payload(e))
 
                 out = list(await asyncio.gather(
                     *(one(a, k) for a, k in items)))
@@ -327,26 +417,31 @@ class _Replica:
                             raise TypeError("deployment is not callable")
                         out.append(("ok", fn(*a, **k)))
                     except Exception as e:  # noqa: BLE001 — isolation
-                        out.append(("err", repr(e)))
+                        out.append(("err", _err_payload(e)))
                 return out
 
             loop = asyncio.get_running_loop()
             call = loop.run_in_executor(None, run_all)
-            results = await (asyncio.wait_for(call, self._timeout)
-                             if self._timeout else call)
+            try:
+                results = await (asyncio.wait_for(call, limit)
+                                 if limit else call)
+            except asyncio.TimeoutError:
+                raise DeadlineExceededError(
+                    f"batch exceeded its deadline ({limit:.3f}s) in "
+                    f"deployment {self._deployment!r}") from None
             final = []
             for tag, val in results:
                 if tag == "ok":
                     try:
                         if inspect.iscoroutine(val):
                             val = await (asyncio.wait_for(
-                                val, self._timeout) if self._timeout
+                                val, limit) if limit
                                 else val)
                         if inspect.isgenerator(val) or inspect.isasyncgen(
                                 val):
                             val = self._register_stream(val)
                     except Exception as e:  # noqa: BLE001 — isolation
-                        tag, val = "err", repr(e)
+                        tag, val = "err", _err_payload(e)
                 final.append((tag, val))
             out = final
             return out
@@ -354,20 +449,34 @@ class _Replica:
             self._observe_batch(start, len(items), out)
             self._ongoing -= len(items)
 
-    async def call_method(self, method, args, kwargs):
+    async def call_method(self, method, args, kwargs,
+                          timeout_s: Optional[float] = None):
         self._ongoing += 1
         self._total += 1
         start = time.perf_counter()
         ok = True
         try:
             return await self._invoke(
-                getattr(self.callable, method), args, kwargs)
+                getattr(self.callable, method), args, kwargs, timeout_s)
         except BaseException:
             ok = False
             raise
         finally:
             self._observe(start, 1, ok)
             self._ongoing -= 1
+
+    async def health_check(self) -> bool:
+        """Controller liveness probe. A replica whose event loop is
+        wedged (sync work on the loop, deadlocked handler) simply never
+        answers — the controller counts the timeout. Deployments can add
+        their own semantics via a ``check_health`` method (raise =
+        unhealthy)."""
+        fn = getattr(self.callable, "check_health", None)
+        if fn is not None:
+            res = fn()
+            if hasattr(res, "__await__"):
+                await res
+        return True
 
     async def next_chunks(self, stream_id: int, max_n: int = 8):
         """Drain up to ``max_n`` items from a registered stream; returns
@@ -423,6 +532,10 @@ class ServeController:
 
         self.deployments: Dict[str, DeploymentInfo] = {}
         self.replicas: Dict[str, List[Any]] = {}
+        # Per-deployment, per-replica (actor-id keyed) probe state:
+        # {"probe": outstanding ref|None, "sent": ts, "fails": n,
+        #  "ok": answered-at-least-once}. See _health_sweep_locked.
+        self._health: Dict[str, Dict[bytes, dict]] = {}
         self._metrics: Dict[str, List[float]] = {}
         self._last_scale_up: Dict[str, float] = {}
         self._last_scale_down: Dict[str, float] = {}
@@ -474,6 +587,7 @@ class ServeController:
         with self._lock:
             info = self.deployments.pop(name, None)
             victims = self.replicas.pop(name, [])
+            self._health.pop(name, None)
             self._bump_locked(name)
         metrics = serve_metrics()
         if metrics is not None:
@@ -489,9 +603,12 @@ class ServeController:
     def listen_for_change(self, name: str, known_version: int,
                           timeout_s: float = 30.0):
         """Block until the replica set of ``name`` changes past
-        ``known_version`` (or timeout); returns (version, replicas).
-        Reference: LongPollHost.listen_for_change — routers hold one of
-        these calls open instead of polling on an interval."""
+        ``known_version`` (or timeout); returns (version, replicas,
+        router_cfg). Reference: LongPollHost.listen_for_change — routers
+        hold one of these calls open instead of polling on an interval.
+        router_cfg carries the deployment's retry/admission/deadline
+        knobs so every config change reaches routers on the same push
+        that delivers replica-set changes."""
         deadline = time.monotonic() + timeout_s
         with self._change:
             while self._versions.get(name, 0) <= known_version:
@@ -500,7 +617,21 @@ class ServeController:
                     break
                 self._change.wait(remaining)
             return (self._versions.get(name, 0),
-                    list(self.replicas.get(name, [])))
+                    list(self.replicas.get(name, [])),
+                    self._router_cfg_locked(name))
+
+    def _router_cfg_locked(self, name: str) -> dict:
+        info = self.deployments.get(name)
+        if info is None:
+            return {}
+        return {
+            "max_request_retries": info.max_request_retries,
+            "retry_backoff_s": info.retry_backoff_s,
+            "idempotent": info.idempotent,
+            "max_pending": info.max_pending,
+            "queue_timeout_s": info.queue_timeout_s,
+            "request_deadline_s": info.request_deadline_s,
+        }
 
     def reconfigure_deployment(self, name: str, user_config) -> int:
         """Push a new user_config to every live replica in parallel;
@@ -544,7 +675,8 @@ class ServeController:
     def get_replica_snapshot(self, name: str):
         with self._lock:
             return (self._versions.get(name, 0),
-                    list(self.replicas.get(name, [])))
+                    list(self.replicas.get(name, [])),
+                    self._router_cfg_locked(name))
 
     def get_deployment_names(self) -> List[str]:
         with self._lock:
@@ -614,6 +746,77 @@ class ServeController:
                 out[name] = self._reconcile_deployment(name)
         return out
 
+    def _health_sweep_locked(self, name: str, info: DeploymentInfo,
+                             current: List[Any]) -> bool:
+        """Probe every replica's liveness; evict the ones past the
+        failure threshold. Returns True when the replica set changed
+        (the caller's target loop then creates replacements — target-
+        count reconciliation, never in-place restart, so routers can't
+        keep dispatching to a stale handle).
+
+        Probe outcomes per replica (actor-id keyed state):
+          - probe resolves OK          -> fails = 0, mark responsive
+          - probe resolves with error  -> dead/raising: evict NOW (the
+            runtime already knows the actor died; waiting out the
+            threshold only extends the outage)
+          - probe outstanding past health_check_timeout_s -> hung: count
+            one failure, but ONLY once the replica has answered at least
+            one probe — a replica still constructing (LLM warmup can
+            compile for many seconds) must not be culled mid-warmup.
+        """
+        now = time.monotonic()
+        hstate = self._health.setdefault(name, {})
+        threshold = max(1, info.health_check_failure_threshold)
+        live_keys = set()
+        dead: List[Any] = []
+        for r in current:
+            key = r._actor_id.binary()
+            live_keys.add(key)
+            st = hstate.setdefault(key, {"probe": None, "sent": now,
+                                         "fails": 0, "ok": False})
+            probe = st["probe"]
+            if probe is not None:
+                ready, _ = wait([probe], num_returns=1, timeout=0)
+                if ready:
+                    st["probe"] = None
+                    try:
+                        get(ready[0])
+                        st["fails"] = 0
+                        st["ok"] = True
+                    except Exception:
+                        st["fails"] = threshold
+                elif now - st["sent"] > info.health_check_timeout_s:
+                    st["probe"] = None
+                    if st["ok"]:
+                        st["fails"] += 1
+            if (st["probe"] is None and st["fails"] < threshold
+                    and now - st["sent"] >= info.health_check_period_s):
+                try:
+                    st["probe"] = r.health_check.remote()
+                    st["sent"] = now
+                except Exception:
+                    st["fails"] = threshold
+            if st["fails"] >= threshold:
+                dead.append((r, key))
+        for key in [k for k in hstate if k not in live_keys]:
+            hstate.pop(key)
+        metrics = serve_metrics()
+        if metrics is not None:
+            metrics["unhealthy"].set(float(len(dead)),
+                                     tags={"deployment": name})
+        if not dead:
+            return False
+        for r, key in dead:
+            current.remove(r)
+            hstate.pop(key, None)
+            try:
+                kill(r)  # hung replicas hold a worker process hostage
+            except Exception:
+                pass
+            if metrics is not None:
+                metrics["restarts"].inc(1.0, tags={"deployment": name})
+        return True
+
     def _reconcile_deployment(self, name: str, redeploy: bool = False) -> int:
         info = self.deployments[name]
         current = self.replicas.setdefault(name, [])
@@ -624,9 +827,13 @@ class ServeController:
                 except Exception:
                     pass
             current.clear()
+            self._health.pop(name, None)
         target = self._target_replicas(name)
         replica_cls = remote(_Replica)
         changed = redeploy
+        if not redeploy and info.health_check_period_s is not None:
+            changed = self._health_sweep_locked(name, info,
+                                                current) or changed
         while len(current) < target:
             changed = True
             opts = dict(info.ray_actor_options)
@@ -698,6 +905,14 @@ class Router:
         self._metrics = None if is_worker_process() else serve_metrics()
         if self._metrics is not None:
             self._qd_key = (("deployment", deployment_name),)
+        # Deployment retry/admission/deadline knobs, pushed by the
+        # controller on the same long-poll as replica-set changes.
+        self._cfg: Dict[str, Any] = {}
+        # oid-binary -> replica that ACTUALLY served a retried request
+        # (bounded; see replica_for) — streaming consumers must drain
+        # next_chunks from the replica that holds the stream, not the
+        # dead one originally picked.
+        self._retried_replica: Dict[bytes, Any] = {}
         self._waiters = 0  # blocked assigners; gate for notify_all
         self._lock = threading.Lock()
         self._slot_free = threading.Condition(self._lock)
@@ -711,12 +926,13 @@ class Router:
         """Long-poll: one blocking listen_for_change call held open."""
         while not self._stop.is_set():
             try:
-                version, replicas = get(
+                version, replicas, cfg = get(
                     self._controller.listen_for_change.remote(
                         self._name, self._version),
                     timeout=45,
                 )
                 with self._slot_free:
+                    self._cfg = cfg or {}
                     if version != self._version:
                         self._version = version
                         self._set_replicas_locked(replicas)
@@ -729,6 +945,20 @@ class Router:
     def _set_replicas_locked(self, replicas) -> None:
         self._replicas = replicas
         self._keys = [r._actor_id.binary() for r in replicas]
+        # Evicted-replica cleanup: in-flight counts keyed by a replica
+        # that left the set would otherwise linger forever — its
+        # requests fail (actor death) and their _release clamps to the
+        # popped key's 0, so the router-wide total (_nq and the shared
+        # queue-depth gauge) stays permanently offset: the phantom-
+        # queue-depth leak. Give the residual back NOW; late _release
+        # calls on the popped key no-op against the clamp.
+        live = set(self._keys)
+        for key in [k for k in self._inflight if k not in live]:
+            residual = self._inflight.pop(key)
+            if residual:
+                self._note_inflight(-residual)
+        if self._waiters:
+            self._slot_free.notify_all()
 
     def _ensure_replicas(self, timeout: float = 5.0) -> None:
         """First-use bootstrap: snapshot directly (the long-poll only
@@ -736,11 +966,12 @@ class Router:
         if self._replicas:
             return
         try:
-            version, replicas = get(
+            version, replicas, cfg = get(
                 self._controller.get_replica_snapshot.remote(self._name),
                 timeout=timeout,
             )
             with self._slot_free:
+                self._cfg = cfg or {}
                 if version >= self._version and replicas:
                     self._version = version
                     self._set_replicas_locked(replicas)
@@ -784,7 +1015,7 @@ class Router:
     def assign(self, method: Optional[str], args, kwargs):
         return self.assign_with_replica(method, args, kwargs)[0]
 
-    def _pick_slot_locked(self):
+    def _pick_slot_locked(self, avoid: Optional[bytes] = None):
         """Under self._slot_free: least-loaded pick with a sticky tie
         break. Pure round-robin spreads consecutive requests across
         actors, defeating the core runtime's per-actor submission
@@ -806,6 +1037,25 @@ class Router:
         n = len(self._replicas)
         if n == 0:
             return None
+        if avoid is not None and n > 1:
+            # Retry re-dispatch: least-loaded scan SKIPPING the replica
+            # that just failed the request. Soft exclusion — when every
+            # other replica is at capacity we fall through to the
+            # normal pick (retrying the suspect beats shedding).
+            best = best_key = best_load = None
+            for idx in range(n):
+                key = self._keys[idx]
+                if key == avoid:
+                    continue
+                load = self._inflight.get(key, 0)
+                if load >= self._max_cq:
+                    continue
+                if best_load is None or load < best_load:
+                    best, best_key, best_load = idx, key, load
+            if best is not None:
+                self._inflight[best_key] = best_load + 1
+                self._note_inflight(1)
+                return self._replicas[best], best_key
         if self._rr >= n:
             self._rr = 0
         skey = self._keys[self._rr]
@@ -848,12 +1098,68 @@ class Router:
         self._note_inflight(1)
         return self._replicas[best], best_key
 
-    def _submit(self, replica, key, method, args, kwargs):
+    # -- deadlines / admission ----------------------------------------------
+    def _deadlines(self, deadline: Optional[float]):
+        """(request_deadline, queue_deadline): the end-to-end deadline
+        (explicit per-request, else the deployment's request_deadline_s,
+        else None) and how long this assign may wait for a slot — the
+        deployment's queue_timeout_s (default 30s, the old hardcoded
+        bound) clamped so queueing never outlives the deadline."""
+        now = time.monotonic()
+        if deadline is None:
+            rd = self._cfg.get("request_deadline_s")
+            deadline = now + rd if rd is not None else None
+        qt = self._cfg.get("queue_timeout_s")
+        queue_deadline = now + (qt if qt is not None else 30.0)
+        if deadline is not None:
+            queue_deadline = min(queue_deadline, deadline)
+        return deadline, queue_deadline
+
+    def _timeout_for(self, deadline: Optional[float]) -> Optional[float]:
+        if deadline is None:
+            return None
+        return max(0.0, deadline - time.monotonic())
+
+    def _admit_locked(self, queued: bool) -> bool:
+        """First time an assign is about to block: check the
+        deployment-wide pending bound and register the waiter. Raises
+        OverloadedError when the queue is already full."""
+        if queued:
+            return True
+        mp = self._cfg.get("max_pending")
+        if mp is not None and _pending_note(self._name, 0) >= mp:
+            raise OverloadedError(
+                f"deployment {self._name!r} overloaded: pending queue "
+                f"is full (max_pending={mp})")
+        _pending_note(self._name, 1)
+        return True
+
+    def _count_retry(self, reason: str) -> None:
+        if self._metrics is not None:
+            self._metrics["retries"].inc(
+                1.0, tags={"deployment": self._name, "reason": reason})
+
+    def _count_deadline(self) -> None:
+        if self._metrics is not None:
+            self._metrics["deadline_exceeded"].inc(1.0)
+
+    def _overloaded(self) -> OverloadedError:
+        detail = (f" (all at max_concurrent_queries={self._max_cq})"
+                  if self._replicas else "")
+        return OverloadedError(
+            f"deployment {self._name!r} overloaded: no replica "
+            f"available{detail}")
+
+    def _submit(self, replica, key, method, args, kwargs,
+                deadline: Optional[float] = None):
+        timeout_s = self._timeout_for(deadline)
         try:
             if method:
-                ref = replica.call_method.remote(method, args, kwargs)
+                ref = replica.call_method.remote(method, args, kwargs,
+                                                 timeout_s)
             else:
-                ref = replica.handle_request.remote(args, kwargs)
+                ref = replica.handle_request.remote(args, kwargs,
+                                                    timeout_s)
         except Exception:
             self._release(key)
             raise
@@ -861,10 +1167,168 @@ class Router:
         from ..core import on_ref_ready
 
         on_ref_ready(ref, lambda k=key: self._release(k))
+        self._arm_retry(ref, key, ("unary", method, args, kwargs),
+                        deadline)
         return ref, replica
 
+    # -- safe retry (replica died before any response byte) -----------------
+    def _arm_retry(self, ref, key, call, deadline: Optional[float],
+                   slots: int = 1) -> None:
+        """Register a one-shot failure interceptor on the request's
+        return oid: if the replica dies before the result lands, the
+        request is re-dispatched to a healthy replica while the caller
+        keeps waiting on the ORIGINAL ref. Zero cost on the success
+        path. Disabled for non-idempotent deployments (a duplicate side
+        effect is worse than a typed error) and in worker processes
+        (the interceptor needs the head runtime's object table)."""
+        if self._cfg.get("max_request_retries", 0) <= 0:
+            return
+        if not self._cfg.get("idempotent", True):
+            return
+        from ..core.runtime import get_head_runtime
+
+        rt = get_head_runtime()
+        if rt is None:
+            return
+        ctx = {
+            "call": call,
+            "user_deadline": deadline,
+            # Retry chains are always bounded, even with no user
+            # deadline: a replacement replica that never comes up must
+            # not park the caller forever.
+            "deadline": (deadline if deadline is not None
+                         else time.monotonic() + 60.0),
+            "bad": key,
+            "slots": slots,
+        }
+        rt.intercept_failure(
+            ref.id, lambda err, o=ref.id, c=ctx: self._maybe_retry(
+                o, c, err))
+
+    def _maybe_retry(self, oid, ctx, error) -> bool:
+        """Failure-interceptor body. Runs on whatever thread delivered
+        the failure (possibly holding the runtime lock): decide and
+        hand off, never block. True = we own completing the oid."""
+        if not isinstance(error, (ActorError, WorkerCrashedError)):
+            return False  # app exception: not retryable, fail normally
+        if time.monotonic() >= ctx["deadline"]:
+            return False
+        threading.Thread(
+            target=self._retry_loop, args=(oid, ctx, error),
+            daemon=True, name=f"serve-retry-{self._name}").start()
+        return True
+
+    def _pick_for_retry(self, avoid: bytes, deadline: float):
+        while True:
+            with self._slot_free:
+                chosen = self._pick_slot_locked(avoid=avoid)
+                if chosen is not None:
+                    return chosen
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._waiters += 1
+                try:
+                    self._slot_free.wait(min(remaining, 0.5))
+                finally:
+                    self._waiters -= 1
+            self._ensure_replicas()
+
+    def _retry_loop(self, oid, ctx, error) -> None:
+        """Re-dispatch a dead request until it lands, the retry budget
+        runs out, or the deadline passes. Owns the original oid's
+        completion (fail_object / transfer_result)."""
+        from ..core import on_ref_ready
+        from ..core.runtime import get_head_runtime
+
+        rt = get_head_runtime()
+        budget = int(self._cfg.get("max_request_retries", 0))
+        backoff0 = float(self._cfg.get("retry_backoff_s", 0.05))
+        n_slots = int(ctx.get("slots", 1))
+        avoid = ctx["bad"]
+        last_err = error
+        attempt = 0
+        while True:
+            attempt += 1
+            if attempt > budget:
+                rt.fail_object(oid, last_err)
+                return
+            self._count_retry("actor_died")
+            delay = min(backoff0 * (2 ** (attempt - 1)), 1.0)
+            if time.monotonic() + delay >= ctx["deadline"]:
+                self._count_deadline()
+                rt.fail_object(oid, DeadlineExceededError(
+                    f"request to {self._name!r} exceeded its deadline "
+                    f"while retrying after replica death"))
+                return
+            time.sleep(delay)
+            picked = self._pick_for_retry(avoid, ctx["deadline"])
+            if picked is None:
+                self._count_deadline()
+                rt.fail_object(oid, DeadlineExceededError(
+                    f"request to {self._name!r} exceeded its deadline "
+                    f"waiting for a healthy replica"))
+                return
+            replica, key = picked
+            if n_slots > 1:
+                with self._slot_free:
+                    self._inflight[key] = (
+                        self._inflight.get(key, 0) + n_slots - 1)
+                    self._note_inflight(n_slots - 1)
+            timeout_s = self._timeout_for(ctx["user_deadline"])
+            kind = ctx["call"][0]
+            try:
+                if kind == "batch":
+                    ref2 = replica.handle_request_batch.remote(
+                        ctx["call"][1], timeout_s)
+                elif ctx["call"][1]:
+                    ref2 = replica.call_method.remote(
+                        ctx["call"][1], ctx["call"][2], ctx["call"][3],
+                        timeout_s)
+                else:
+                    ref2 = replica.handle_request.remote(
+                        ctx["call"][2], ctx["call"][3], timeout_s)
+            except Exception as e:  # noqa: BLE001
+                self._release(key, n_slots)
+                last_err, avoid = e, key
+                continue
+            on_ref_ready(ref2, lambda k=key, c=n_slots: self._release(
+                k, c))
+            done = threading.Event()
+            rt.add_ready_watcher(ref2.id, done.set)
+            remaining = ctx["deadline"] - time.monotonic()
+            if not done.wait(timeout=max(remaining, 0.0)):
+                self._count_deadline()
+                rt.fail_object(oid, DeadlineExceededError(
+                    f"request to {self._name!r} exceeded its deadline "
+                    f"mid-retry"))
+                return
+            status, err = rt.object_status(ref2.id)
+            if status == "ready":
+                self._note_final_replica(oid, replica)
+                rt.transfer_result(ref2.id, oid)
+                return
+            if isinstance(err, (ActorError, WorkerCrashedError)):
+                last_err, avoid = err, key
+                continue
+            rt.fail_object(oid, err if err is not None else last_err)
+            return
+
+    def _note_final_replica(self, oid, replica) -> None:
+        with self._slot_free:
+            if len(self._retried_replica) > 256:
+                self._retried_replica.clear()
+            self._retried_replica[oid.binary()] = replica
+
+    def replica_for(self, ref, default):
+        """The replica that actually served ``ref`` — the original pick
+        unless a safe retry moved the request (streaming consumers must
+        drain next_chunks from the live replica holding the stream)."""
+        with self._slot_free:
+            return self._retried_replica.get(ref.id.binary(), default)
+
     def try_assign_with_replica(self, method: Optional[str], args,
-                                kwargs):
+                                kwargs, deadline: Optional[float] = None):
         """Non-blocking assign: (ref, replica) or None when every
         replica is at capacity — lets the HTTP proxy submit inline on
         its event loop in the common unsaturated case instead of paying
@@ -874,50 +1338,73 @@ class Router:
         proxy's event loop."""
         if not self._replicas:
             return None
+        if deadline is None:
+            deadline, _ = self._deadlines(None)
         with self._slot_free:
             chosen = self._pick_slot_locked()
         if chosen is None:
             return None
         replica, key = chosen
-        return self._submit(replica, key, method, args, kwargs)
+        return self._submit(replica, key, method, args, kwargs, deadline)
 
-    def assign_with_replica(self, method: Optional[str], args, kwargs):
+    def assign_with_replica(self, method: Optional[str], args, kwargs,
+                            deadline: Optional[float] = None):
         """Pick a replica with a free slot; block (condvar, woken by
         completions and replica-set updates) when all are at capacity.
         Returns (result_ref, replica_handle) — the replica is needed to
-        drain streaming responses (``_Replica.next_chunks``)."""
-        deadline = time.monotonic() + 30
-        self._ensure_replicas()
-        while True:
-            with self._slot_free:
-                chosen = self._pick_slot_locked()
-                if chosen is None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        detail = (f" (all at max_concurrent_queries="
-                                  f"{self._max_cq})"
-                                  if self._replicas else "")
-                        raise RuntimeError(
-                            f"no replica available for "
-                            f"{self._name!r}{detail}")
-                    self._waiters += 1
-                    try:
-                        self._slot_free.wait(min(remaining, 1.0))
-                    finally:
-                        self._waiters -= 1
-            if chosen is None:
-                self._ensure_replicas()
-                continue
-            replica, key = chosen
-            return self._submit(replica, key, method, args, kwargs)
+        drain streaming responses (``_Replica.next_chunks``).
 
-    def try_assign_batch(self, items):
+        Queue-wait is bounded by the deployment's queue_timeout_s (and
+        the request deadline); expiry sheds with a typed error —
+        OverloadedError (-> 503) for queue timeout, DeadlineExceededError
+        (-> 504) when the end-to-end deadline itself passed. max_pending
+        bounds how many assigns may block deployment-wide."""
+        # Bootstrap BEFORE resolving deadlines: on a fresh router the
+        # deployment cfg (request_deadline_s etc.) arrives with the
+        # first replica snapshot — resolving first would silently run
+        # the request unbounded.
+        self._ensure_replicas()
+        deadline, queue_deadline = self._deadlines(deadline)
+        queued = False
+        try:
+            while True:
+                with self._slot_free:
+                    chosen = self._pick_slot_locked()
+                    if chosen is None:
+                        now = time.monotonic()
+                        if deadline is not None and now >= deadline:
+                            self._count_deadline()
+                            raise DeadlineExceededError(
+                                f"request to {self._name!r} exceeded "
+                                f"its deadline while queued")
+                        if now >= queue_deadline:
+                            raise self._overloaded()
+                        queued = self._admit_locked(queued)
+                        self._waiters += 1
+                        try:
+                            self._slot_free.wait(
+                                min(queue_deadline - now, 1.0))
+                        finally:
+                            self._waiters -= 1
+                if chosen is None:
+                    self._ensure_replicas()
+                    continue
+                replica, key = chosen
+                return self._submit(replica, key, method, args, kwargs,
+                                    deadline)
+        finally:
+            if queued:
+                _pending_note(self._name, -1)
+
+    def try_assign_batch(self, items, deadline: Optional[float] = None):
         """Assign a COALESCED batch to ONE replica in a single actor
         RPC. Takes as many items as the replica's free slots allow
         (>= 1). Returns (ref, replica, n_taken) or None when every
         replica is at capacity / the set is empty."""
         if not self._replicas:
             return None
+        if deadline is None:
+            deadline, _ = self._deadlines(None)
         with self._slot_free:
             picked = self._pick_slot_locked()  # takes one slot
             if picked is None:
@@ -928,8 +1415,10 @@ class Router:
             self._inflight[key] += extra
             self._note_inflight(extra)
             n = 1 + extra
+        taken = list(items[:n])
         try:
-            ref = replica.handle_request_batch.remote(list(items[:n]))
+            ref = replica.handle_request_batch.remote(
+                taken, self._timeout_for(deadline))
         except Exception:
             self._release(key, n)
             raise
@@ -937,27 +1426,39 @@ class Router:
         from ..core import on_ref_ready
 
         on_ref_ready(ref, lambda k=key, c=n: self._release(k, c))
+        self._arm_retry(ref, key, ("batch", taken), deadline, slots=n)
         return ref, replica, n
 
-    def assign_batch(self, items):
+    def assign_batch(self, items, deadline: Optional[float] = None):
         """Blocking form of try_assign_batch (saturation path)."""
-        deadline = time.monotonic() + 30
-        self._ensure_replicas()
-        while True:
-            got = self.try_assign_batch(items)
-            if got is not None:
-                return got
-            with self._slot_free:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise RuntimeError(
-                        f"no replica available for {self._name!r}")
-                self._waiters += 1
-                try:
-                    self._slot_free.wait(min(remaining, 1.0))
-                finally:
-                    self._waiters -= 1
-            self._ensure_replicas()
+        self._ensure_replicas()  # cfg before deadlines, as in assign
+        deadline, queue_deadline = self._deadlines(deadline)
+        queued = False
+        try:
+            while True:
+                got = self.try_assign_batch(items, deadline)
+                if got is not None:
+                    return got
+                with self._slot_free:
+                    now = time.monotonic()
+                    if deadline is not None and now >= deadline:
+                        self._count_deadline()
+                        raise DeadlineExceededError(
+                            f"batch for {self._name!r} exceeded its "
+                            f"deadline while queued")
+                    if now >= queue_deadline:
+                        raise self._overloaded()
+                    queued = self._admit_locked(queued)
+                    self._waiters += 1
+                    try:
+                        self._slot_free.wait(
+                            min(queue_deadline - now, 1.0))
+                    finally:
+                        self._waiters -= 1
+                self._ensure_replicas()
+        finally:
+            if queued:
+                _pending_note(self._name, -1)
 
     def _release(self, key: bytes, n: int = 1) -> None:
         with self._slot_free:

@@ -19,11 +19,20 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from ..core import get, get_actor, kill, remote
+from ..core.exceptions import (
+    ActorError,
+    DeadlineExceededError,
+    OverloadedError,
+    StreamInterruptedError,
+    TaskError,
+    WorkerCrashedError,
+)
 from ._internal import (
     AutoscalingConfig,
     DeploymentInfo,
     Router,
     ServeController,
+    serve_metrics,
 )
 
 _CONTROLLER_NAME = "SERVE_CONTROLLER"
@@ -177,11 +186,20 @@ class StreamingResponse:
         if not self._buf:
             if self._done:
                 raise StopIteration
-            done, items = get(
-                self._replica.next_chunks.remote(
-                    self._stream_id, self._chunk),
-                timeout=60,
-            )
+            try:
+                done, items = get(
+                    self._replica.next_chunks.remote(
+                        self._stream_id, self._chunk),
+                    timeout=60,
+                )
+            except (ActorError, WorkerCrashedError) as e:
+                # Replica died mid-stream. Chunks already handed out
+                # can't be un-delivered, so a transparent retry could
+                # duplicate output — fail fast with the typed error.
+                raise StreamInterruptedError(
+                    "streaming replica died after the stream started; "
+                    "already-delivered chunks cannot be retried safely"
+                ) from e
             self._done = done
             self._buf = list(items)
             if not self._buf:
@@ -227,6 +245,9 @@ class DeploymentHandle:
         async generator); returns an iterator over its chunks."""
         ref, replica = self._router.assign_with_replica(None, args, kwargs)
         value = get(ref, timeout=60)
+        # A safe retry may have moved the request to another replica —
+        # the stream must be drained from whichever actor holds it.
+        replica = self._router.replica_for(ref, replica)
         if not _is_stream_marker(value):
             single = StreamingResponse(replica, -1)
             single._buf = [value]
@@ -298,6 +319,16 @@ class Deployment:
             ray_actor_options=o.get("ray_actor_options") or {},
             request_timeout_s=o.get("request_timeout_s"),
             user_config=o.get("user_config"),
+            request_deadline_s=o.get("request_deadline_s"),
+            max_request_retries=o.get("max_request_retries", 2),
+            retry_backoff_s=o.get("retry_backoff_s", 0.05),
+            idempotent=o.get("idempotent", True),
+            max_pending=o.get("max_pending"),
+            queue_timeout_s=o.get("queue_timeout_s"),
+            health_check_period_s=o.get("health_check_period_s", 1.0),
+            health_check_timeout_s=o.get("health_check_timeout_s", 5.0),
+            health_check_failure_threshold=o.get(
+                "health_check_failure_threshold", 3),
         )
         get(_controller().deploy.remote(info), timeout=60)
         return DeploymentHandle(self.name, o.get("max_concurrent_queries",
@@ -315,8 +346,25 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                route_prefix: Optional[str] = None,
                autoscaling_config=None,
                ray_actor_options: Optional[dict] = None,
-               request_timeout_s: Optional[float] = None):
-    """``@serve.deployment`` decorator (reference: serve/api.py)."""
+               request_timeout_s: Optional[float] = None,
+               request_deadline_s: Optional[float] = None,
+               max_request_retries: int = 2,
+               retry_backoff_s: float = 0.05,
+               idempotent: bool = True,
+               max_pending: Optional[int] = None,
+               queue_timeout_s: Optional[float] = None,
+               health_check_period_s: Optional[float] = 1.0,
+               health_check_timeout_s: float = 5.0,
+               health_check_failure_threshold: int = 3):
+    """``@serve.deployment`` decorator (reference: serve/api.py).
+
+    Fault-tolerance / admission knobs (ISSUE 18): ``request_deadline_s``
+    bounds a request end-to-end (queueing + retries + handler; -> 504);
+    ``max_request_retries``/``retry_backoff_s`` govern safe re-dispatch
+    after replica death (disabled when ``idempotent=False``);
+    ``max_pending``/``queue_timeout_s`` shed overload as typed 503s;
+    ``health_check_*`` tune the controller's liveness probes (period
+    None disables)."""
 
     def wrap(target):
         return Deployment(target, name or target.__name__, {
@@ -326,6 +374,16 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             "autoscaling_config": autoscaling_config,
             "ray_actor_options": ray_actor_options or {},
             "request_timeout_s": request_timeout_s,
+            "request_deadline_s": request_deadline_s,
+            "max_request_retries": max_request_retries,
+            "retry_backoff_s": retry_backoff_s,
+            "idempotent": idempotent,
+            "max_pending": max_pending,
+            "queue_timeout_s": queue_timeout_s,
+            "health_check_period_s": health_check_period_s,
+            "health_check_timeout_s": health_check_timeout_s,
+            "health_check_failure_threshold":
+                health_check_failure_threshold,
         })
 
     if _func_or_class is not None:
@@ -514,19 +572,30 @@ class _AsyncHTTPProxy:
         await asyncio.wait_for(fut, timeout)
         return get(ref, timeout=5)
 
-    async def _submit_coalesced(self, name: str, handle, args):
+    async def _submit_coalesced(self, name: str, handle, args,
+                                deadline: Optional[float] = None):
         """Queue one request on the deployment's coalescer and await its
         result. A drainer task per deployment pops whatever is pending
         (up to 16) into ONE replica RPC; batches form naturally from
-        whatever arrives during the previous batch's round trip."""
+        whatever arrives during the previous batch's round trip.
+
+        Admission: when the deployment sets max_pending, a coalescer
+        queue already at the bound sheds the request immediately with
+        the typed OverloadedError (-> 503) instead of growing without
+        limit under a traffic wave."""
         import asyncio
         from collections import deque
 
-        fut = self._loop.create_future()
         q = self._pending.get(name)
         if q is None:
             q = self._pending[name] = deque()
-        q.append((args, fut))
+        mp = handle._router._cfg.get("max_pending")
+        if mp is not None and len(q) >= mp:
+            raise OverloadedError(
+                f"deployment {name!r} overloaded: proxy queue is full "
+                f"(max_pending={mp})")
+        fut = self._loop.create_future()
+        q.append((args, fut, deadline))
         if name not in self._draining:
             self._draining.add(name)
             asyncio.ensure_future(self._drain_pending(name, handle))
@@ -541,18 +610,24 @@ class _AsyncHTTPProxy:
                 batch = []
                 while q and len(batch) < 16:
                     batch.append(q.popleft())
-                items = [(args, {}) for args, _ in batch]
+                items = [(args, {}) for args, _, _ in batch]
+                # Tightest member deadline bounds the whole coalesced
+                # RPC (deadlines within one deployment's batch are near-
+                # uniform: all derive from the same request_deadline_s).
+                dls = [d for _, _, d in batch if d is not None]
+                deadline = min(dls) if dls else None
                 try:
-                    assigned = handle._router.try_assign_batch(items)
+                    assigned = handle._router.try_assign_batch(
+                        items, deadline)
                     if assigned is None:
                         # saturated / empty replica set: block off-loop
                         assigned = await self._loop.run_in_executor(
-                            None, lambda it=items:
-                            handle._router.assign_batch(it))
+                            None, lambda it=items, dl=deadline:
+                            handle._router.assign_batch(it, dl))
                 except Exception as e:  # noqa: BLE001 — a dead replica
                     # must 500 the batch, never strand its futures (the
                     # drainer survives to serve later arrivals).
-                    for _, fut in batch:
+                    for _, fut, _ in batch:
                         if not fut.done():
                             fut.set_exception(e)
                     continue
@@ -563,23 +638,46 @@ class _AsyncHTTPProxy:
                     batch = batch[:n]
                 # distribute concurrently; keep draining new arrivals
                 asyncio.ensure_future(
-                    self._distribute(ref, replica, batch))
+                    self._distribute(ref, replica, batch, deadline))
         finally:
             self._draining.discard(name)
 
-    async def _distribute(self, ref, replica, batch):
+    async def _distribute(self, ref, replica, batch,
+                          deadline: Optional[float] = None):
+        import asyncio
+
+        timeout = 60.0
+        if deadline is not None:
+            # +2s slack: the replica/router enforce the deadline with a
+            # typed error; this watchdog only catches a replica that
+            # stopped responding entirely, so a request can never hang.
+            timeout = max(0.0, min(timeout,
+                                   deadline - time.monotonic() + 2.0))
         try:
-            results = await self._aget(ref, 60)
+            results = await self._aget(ref, timeout)
+        except asyncio.TimeoutError as e:
+            err: Exception = (DeadlineExceededError(
+                "request exceeded its deadline awaiting the replica")
+                if deadline is not None else e)
+            for _, fut, _ in batch:
+                if not fut.done():
+                    fut.set_exception(err)
+            return
         except Exception as e:  # noqa: BLE001 — replica died mid-batch
-            for _, fut in batch:
+            for _, fut, _ in batch:
                 if not fut.done():
                     fut.set_exception(e)
             return
-        for (_, fut), res in zip(batch, results):
+        for (_, fut, _), res in zip(batch, results):
             if fut.done():
                 continue
             if res[0] == "err":
-                fut.set_exception(RuntimeError(res[1]))
+                # Typed control-flow errors travel as live exceptions
+                # (isinstance-matched to 503/504); everything else is a
+                # transport-safe repr string.
+                err = (res[1] if isinstance(res[1], BaseException)
+                       else RuntimeError(res[1]))
+                fut.set_exception(err)
             else:
                 fut.set_result((res[1], replica))
 
@@ -603,7 +701,8 @@ class _AsyncHTTPProxy:
                 length = int(headers.get("content-length", 0) or 0)
                 body = await reader.readexactly(length) if length else b""
                 keep = headers.get("connection", "keep-alive") != "close"
-                keep = await self._route(writer, target, body, keep) and keep
+                keep = await self._route(writer, target, body, keep,
+                                         headers) and keep
                 await writer.drain()
                 if not keep:
                     return
@@ -640,7 +739,7 @@ class _AsyncHTTPProxy:
         return best[1] if best else None
 
     async def _route(self, writer, target: str, body: bytes,
-                     keep: bool) -> bool:
+                     keep: bool, headers: Optional[dict] = None) -> bool:
         """Handle one request. Returns False when the connection must be
         closed (e.g. a failure after a chunked response started — a 500
         cannot be written into the middle of a chunked body)."""
@@ -653,6 +752,17 @@ class _AsyncHTTPProxy:
                 payload = json.loads(body)
             except json.JSONDecodeError:
                 payload = body.decode("utf-8", "replace")
+        # Per-request deadline: x-serve-deadline-s (seconds from now)
+        # overrides the deployment's request_deadline_s; it flows
+        # proxy -> router -> replica, so retries and queueing can never
+        # extend total latency past what the client asked for.
+        deadline = None
+        hdr = (headers or {}).get("x-serve-deadline-s")
+        if hdr:
+            try:
+                deadline = time.monotonic() + max(float(hdr), 0.0)
+            except ValueError:
+                pass
         name = None
         try:
             import time as _time
@@ -690,7 +800,7 @@ class _AsyncHTTPProxy:
                 self._handles[name] = handle
             args = () if payload is None else (payload,)
             result, replica = await self._submit_coalesced(
-                name, handle, args)
+                name, handle, args, deadline)
         except Exception as e:  # noqa: BLE001
             # No cache surgery here: an application-level 500 says
             # nothing about routes, and the TTL already bounds how long
@@ -699,21 +809,35 @@ class _AsyncHTTPProxy:
             # tracks replica-set changes itself; popping it per failing
             # request would leak one such thread each time.
             #
-            # Admission sheds (bounded pending queue / queue timeout in
-            # the deployment) surface as a typed OverloadedError; map it
-            # to 503 so clients can distinguish "back off and retry"
-            # from a real failure. The error may arrive re-raised or
-            # wrapped after the actor boundary, so match the type NAME
-            # and the message marker, not the class identity.
-            overloaded = ("OverloadedError" in type(e).__name__
-                          or "overloaded" in str(e).lower())
+            # Typed error mapping: admission sheds (bounded pending
+            # queue / queue timeout) surface as OverloadedError -> 503
+            # ("back off and retry"), expired deadlines as
+            # DeadlineExceededError -> 504 — both shared classes from
+            # core.exceptions, isinstance-matched through the TaskError
+            # wrapper the actor boundary adds around replica raises.
+            root = e
+            while isinstance(root, TaskError) and root.cause is not None:
+                root = root.cause
+            overloaded = isinstance(root, OverloadedError)
+            deadline_exceeded = (not overloaded and
+                                 isinstance(root, DeadlineExceededError))
+            if deadline_exceeded and root is not e:
+                # Replica-side deadline expiry: count here (router-side
+                # raises already incremented the counter themselves).
+                m = serve_metrics()
+                if m is not None:
+                    m["deadline_exceeded"].inc(1.0)
             try:
                 body = {"error": str(e)}
+                status = 500
                 if overloaded:
                     body["overloaded"] = True
+                    status = 503
+                elif deadline_exceeded:
+                    body["deadline_exceeded"] = True
+                    status = 504
                 self._write_simple(
-                    writer, 503 if overloaded else 500,
-                    json.dumps(body).encode(), keep)
+                    writer, status, json.dumps(body).encode(), keep)
             except Exception:
                 return False
             return True

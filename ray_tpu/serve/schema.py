@@ -16,6 +16,8 @@ from typing import Any, Dict, List, Optional
 _DEPLOYMENT_FIELDS = (
     "num_replicas", "max_concurrent_queries", "route_prefix",
     "autoscaling_config", "ray_actor_options", "request_timeout_s",
+    "request_deadline_s", "max_pending", "queue_timeout_s",
+    "health_check_period_s",
 )
 
 
@@ -30,6 +32,12 @@ class DeploymentSchema:
     autoscaling_config: Optional[Dict[str, Any]] = None
     ray_actor_options: Optional[Dict[str, Any]] = None
     request_timeout_s: Optional[float] = None
+    # Fault tolerance / admission (ISSUE 18): end-to-end deadline,
+    # bounded pending queue, queue-wait shed, health-probe period.
+    request_deadline_s: Optional[float] = None
+    max_pending: Optional[int] = None
+    queue_timeout_s: Optional[float] = None
+    health_check_period_s: Optional[float] = None
     user_config: Optional[Dict[str, Any]] = None
 
     @classmethod

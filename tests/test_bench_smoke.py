@@ -54,6 +54,22 @@ def test_bench_smoke_runs_all_stages():
     assert mixed["stream_first_chunk_p99_ms"] >= \
         mixed["stream_first_chunk_p50_ms"]
 
+    # Serve chaos stage (ISSUE 18): a replica SIGKILLed under live
+    # traffic — every request must end success / typed 503 / typed
+    # deadline (zero hangs, zero raw 500s) and the controller must
+    # replace the corpse, committing the replacement latency.
+    assert "serve_chaos_error" not in result, result
+    chaos = result["serve_chaos"]
+    assert chaos["kills"] >= 1, chaos
+    counts = chaos["counts"]
+    assert counts["hung"] == 0, chaos
+    assert counts["raw_500"] == 0, chaos
+    assert counts["other"] == 0, chaos
+    assert counts["ok"] > 0, chaos
+    assert chaos["replaced_ms_p50"] > 0, chaos
+    assert chaos["replaced_ms_p99"] >= chaos["replaced_ms_p50"], chaos
+    assert chaos["during_kill_p99_ms"] >= 0, chaos
+
     # Telemetry plane wired through the bench: the mid-bench /metrics
     # scrape must see runtime counters AND worker/replica-shipped series
     # (latency histograms travel worker -> head over the pipe).
