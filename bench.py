@@ -198,6 +198,7 @@ def main():
                          ("serve_bench", "bench_serve"),
                          ("serve_mixed", "bench_serve_mixed"),
                          ("serve_chaos", "bench_serve_chaos"),
+                         ("llm_drain", "bench_llm_drain"),
                          ("envelope", "bench_envelope"),
                          ("ring_parity", "bench_ring_parity"),
                          ("head_failover", "bench_head_failover")):
@@ -984,6 +985,286 @@ def bench_serve_chaos(smoke: bool = False) -> dict:
     return out
 
 
+def bench_llm_drain(smoke: bool = False) -> dict:
+    """Stateful-session robustness stage (ISSUE 19): multi-turn chat
+    sessions — greedy AND seeded sampling — against a replicated LLM
+    deployment, then (a) DRAIN the replica hosting them mid-traffic
+    (sessions migrate via KV page export/import, in-flight generations
+    finish), and (b) SIGKILL the replica hosting a session while its
+    generation is in flight (safe retry completes it elsewhere; the
+    next turn re-pins and recovers by re-prefilling the head-side
+    transcript log). The contract: zero raw 500s, zero hung requests,
+    zero drain-caused 503s, and every post-drain/post-crash turn
+    bit-for-bit identical to an undisturbed reference conversation.
+    Commits migration latency p50/p99 and recovery-by-re-prefill
+    latency p50/p99."""
+    import os as _os
+    import signal as _signal
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import ray_tpu as rt
+    from ray_tpu import serve
+    from ray_tpu.cluster_utils import ReplicaKiller
+    from ray_tpu.llm.serve import build_llm_app
+    from ray_tpu.serve.api import _controller
+
+    rt.init(ignore_reinit_error=True, num_cpus=4)
+    port = 18251
+    serve.start(http_port=port)
+    fast = smoke and os.environ.get("BENCH_SMOKE_FAST") == "1"
+    name = "llmdrain"
+    n_replicas = 2
+    n_filler = 0 if fast else (1 if smoke else 4)
+    kills_planned = 1 if smoke else 2
+    counts = {"ok": 0, "typed_503": 0, "deadline": 0, "raw_500": 0,
+              "other": 0, "hung": 0}
+    in_drain = [False]
+    drain_503 = [0]
+    lock = threading.Lock()
+    url = f"http://127.0.0.1:{port}/{name}"
+
+    def turn(sid, prompt, temperature=0.0, seed=None, max_new=4,
+             timeout=120.0):
+        """One conversation turn over HTTP with the sticky-session
+        header; classifies the outcome and returns the token list (or
+        None on a non-200)."""
+        body = {"prompt": list(prompt), "max_tokens": max_new,
+                "temperature": temperature}
+        if seed is not None:
+            body["seed"] = seed
+        req = urllib.request.Request(
+            url, data=json.dumps(body).encode(),
+            headers={"content-type": "application/json",
+                     "x-serve-session": sid})
+        try:
+            resp = json.loads(urllib.request.urlopen(
+                req, timeout=timeout).read())
+            with lock:
+                counts["ok"] += 1
+            return resp.get("tokens")
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            with lock:
+                if e.code == 503 and b"overloaded" in body:
+                    counts["typed_503"] += 1
+                    if in_drain[0]:
+                        drain_503[0] += 1
+                elif e.code == 504:
+                    counts["deadline"] += 1
+                elif e.code >= 500:
+                    counts["raw_500"] += 1
+                else:
+                    counts["other"] += 1
+        except TimeoutError:
+            with lock:
+                counts["hung"] += 1
+        except Exception:  # noqa: BLE001 — dropped conn etc.
+            with lock:
+                counts["other"] += 1
+        return None
+
+    # Conversation shape (llama-tiny max_seq=128): shared 24-token
+    # system prompt + 1-token user turns, 4 new tokens per turn, 4
+    # turns -> the final prompt stays well inside the budget.
+    sysp = list(range(2, 26))
+    n_turns = 4
+    modes = [("greedy", 0.0, None), ("seeded", 1.0, 77)]
+
+    def converse(sid, temperature, seed, hooks=None):
+        """Run the canonical conversation; ``hooks[t]`` (if set) runs
+        BEFORE turn t. Returns per-turn token lists."""
+        hist = list(sysp)
+        turns = []
+        for t in range(n_turns):
+            if hooks and t in hooks:
+                hooks[t]()
+            toks = turn(sid, hist + [30 + t], temperature, seed)
+            turns.append(toks)
+            hist = hist + [30 + t] + (toks or [])
+        return turns
+
+    def replica_sessions():
+        """actor-hex -> resident session ids, per live replica."""
+        reps = rt.get(_controller().get_replicas.remote(name),
+                      timeout=15)
+        out = {}
+        for r in reps:
+            try:
+                out[r._actor_id.hex()] = rt.get(
+                    r.call_method.remote("sessions", (), {}),
+                    timeout=15)
+            except Exception:  # noqa: BLE001 — replica mid-replacement
+                out[r._actor_id.hex()] = []
+        return out
+
+    out = {"replicas": n_replicas, "turns": n_turns,
+           "kills_planned": kills_planned}
+    migrate_ms = []
+    recovery_ms = []
+    parity = {m: True for m, _, _ in modes}
+    bg_stop = threading.Event()
+
+    def bg_traffic():
+        # Live multi-session traffic riding through both chaos phases:
+        # its own sticky session, pinned wherever the hash lands — so
+        # drains and kills always happen UNDER load.
+        hist = list(sysp)
+        i = 0
+        while not bg_stop.is_set():
+            toks = turn("bg-keep", hist + [60 + (i % 40)], 0.0, None)
+            if toks:
+                hist = list(sysp)  # keep the prompt bounded
+            i += 1
+            bg_stop.wait(0.05)
+
+    try:
+        app = build_llm_app(
+            model="llama-tiny", num_slots=4, chunk=8, page_size=8,
+            seed=0, name=name, num_replicas=n_replicas,
+            health_check_period_s=0.25, health_check_timeout_s=1.0,
+            health_check_failure_threshold=2)
+        serve.run(app)
+        turn("warm", sysp, timeout=180.0)  # replicas compiled + routable
+
+        # Reference pass: undisturbed conversations, one per sampling
+        # mode — the parity baseline every chaos-phase turn must match.
+        ref = {m: converse("ref-" + m, tp, sd)
+               for m, tp, sd in modes}
+        for m, _, _ in modes:
+            if any(t is None for t in ref[m]):
+                raise RuntimeError(f"reference pass failed: {ref[m]}")
+
+        bg = threading.Thread(target=bg_traffic, daemon=True)
+        bg.start()
+
+        # -- Phase A: graceful drain between turns 2 and 3 ---------------
+        # Filler sessions fatten the victim's resident set so the
+        # migration latency sample is more than a single page batch.
+        for i in range(n_filler):
+            converse(f"fill-{i}", 0.0, None)
+        mig = {}
+        overlap_box = {}
+
+        def drain_now():
+            sess = replica_sessions()
+            victim = max(sess, key=lambda h: sum(
+                1 for s in sess[h] if s.startswith(("mig-", "fill-"))))
+            # Overlapped generation: fired at the drain instant, in
+            # flight ON the deployment while the victim quiesces — must
+            # complete, never 503/sever.
+            ov = threading.Thread(target=lambda: overlap_box.update(
+                r=turn("overlap", sysp + [40], 0.0, None, max_new=16)))
+            in_drain[0] = True
+            ov.start()
+            rep = serve.drain(name, replica=victim, timeout_s=60.0)
+            in_drain[0] = False
+            ov.join(timeout=120)
+            out["drain"] = {k: rep.get(k) for k in
+                            ("sessions_migrated", "migrate_errors",
+                             "timed_out", "drained_ms", "error")}
+            migrate_ms.extend(rep.get("migrate_ms") or [])
+
+        hooks = {2: drain_now}
+        for m, tp, sd in modes:
+            mig[m] = converse("mig-" + m, tp, sd, hooks=hooks)
+            hooks = None  # drain once, on the first mode's turn 3
+        for m, _, _ in modes:
+            parity[m] = parity[m] and mig[m] == ref[m]
+        if overlap_box.get("r") is None:
+            counts["other"] += 1  # overlapped turn must have completed
+
+        # -- Phase B: SIGKILL mid-generation + re-prefill recovery -------
+        killer = ReplicaKiller(name, seed=0)
+        kills_done = 0
+        crash = {m: [] for m, _, _ in modes}
+        hists = {m: list(sysp) for m, _, _ in modes}
+        for m, tp, sd in modes:
+            for t in range(2):
+                toks = turn("cr-" + m, hists[m] + [30 + t], tp, sd)
+                crash[m].append(toks)
+                hists[m] += [30 + t] + (toks or [])
+        for _k in range(kills_planned):
+            sess = replica_sessions()
+            pids = killer.replica_pids()
+            victim_hex = max(sess, key=lambda h: sum(
+                1 for s in sess[h] if s.startswith("cr-")))
+            victim_bin = bytes.fromhex(victim_hex)
+            if victim_bin not in pids:
+                out.setdefault("notes", []).append(
+                    "crash victim had no live pid")
+                continue
+            if _k == 0:
+                # Turn 3 in flight on the victim when the SIGKILL
+                # lands: safe retry must finish it on a survivor,
+                # bit-for-bit (client-pinned seed).
+                boxes = {}
+                ths = []
+                for m, tp, sd in modes:
+                    th = threading.Thread(
+                        target=lambda m=m, tp=tp, sd=sd: boxes.update(
+                            {m: turn("cr-" + m, hists[m] + [32], tp,
+                                     sd)}))
+                    th.start()
+                    ths.append(th)
+                time.sleep(0.1)
+            t_kill = time.perf_counter()
+            _os.kill(pids[victim_bin], _signal.SIGKILL)
+            kills_done += 1
+            if _k == 0:
+                for th in ths:
+                    th.join(timeout=120)
+                for m, _, _ in modes:
+                    crash[m].append(boxes.get(m))
+                    hists[m] += [32] + (boxes.get(m) or [])
+            # Replacement: corpse evicted + target count restored.
+            while time.perf_counter() - t_kill < 30.0:
+                pids_now = killer.replica_pids()
+                if (victim_bin not in pids_now
+                        and len(pids_now) >= n_replicas):
+                    break
+                time.sleep(0.05)
+            time.sleep(0.5)  # router long-poll settles on the new set
+        # Turn 4: the crashed sessions re-pin and recover via the
+        # head-side transcript re-prefill — continuation stays exact.
+        for m, tp, sd in modes:
+            toks = turn("cr-" + m, hists[m] + [33], tp, sd)
+            crash[m].append(toks)
+        for m, _, _ in modes:
+            parity[m] = parity[m] and crash[m] == ref[m]
+
+        bg_stop.set()
+        bg.join(timeout=30)
+        for st in (rt.get(r.call_method.remote("stats", (), {}),
+                          timeout=15)
+                   for r in rt.get(
+                       _controller().get_replicas.remote(name),
+                       timeout=15)):
+            recovery_ms.extend(st.get("session_recovery_ms") or [])
+        out["kills"] = kills_done + len(killer.killed)
+        out["counts"] = dict(counts)
+        out["drain_503"] = drain_503[0]
+        out["parity_greedy"] = parity["greedy"]
+        out["parity_seeded"] = parity["seeded"]
+        out.update({"migrate_ms_" + k: v for k, v in
+                    percentiles(migrate_ms).items()})
+        out.update({"recovery_ms_" + k: v for k, v in
+                    percentiles(recovery_ms).items()})
+        out["recovery_samples"] = len(recovery_ms)
+        out["detail"] = (
+            "llama-tiny 2-replica serve app; per-mode (greedy + "
+            "seeded) 4-turn sessions; drain migrates resident "
+            "sessions' KV pages between turns under live traffic; "
+            "SIGKILL mid-generation exercises safe retry + transcript "
+            "re-prefill re-pin; parity = chaos turns identical to an "
+            "undisturbed reference conversation")
+    finally:
+        bg_stop.set()
+        serve.shutdown()
+    return out
+
+
 def bench_llm(on_tpu: bool) -> dict:
     """On-TPU LLM serving: continuous-batching tokens/s + req/s at
     concurrency 1/4/8 (VERDICT r4 item 1). Engine-level measurement in
@@ -1686,6 +1967,13 @@ def smoke() -> dict:
         result["llm_sessions"] = bench_llm_sessions(False, smoke=True)
     except Exception as e:  # noqa: BLE001
         result["llm_sessions_error"] = repr(e)[:300]
+    # Session-migration chaos stage (ISSUE 19): drain + SIGKILL under
+    # live session traffic — zero drops and bit-for-bit continuation
+    # parity are asserted by the smoke test.
+    try:
+        result["llm_drain"] = bench_llm_drain(smoke=True)
+    except Exception as e:  # noqa: BLE001
+        result["llm_drain_error"] = repr(e)[:300]
     # Long-gen decode + roofline stage (ISSUE 17), incl. the tp2 parity
     # sub-stage when the host exposes >= 2 (possibly virtual) devices.
     try:

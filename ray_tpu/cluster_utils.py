@@ -9,10 +9,28 @@ and resource ledger) share one control store in the head process.
 
 from __future__ import annotations
 
+import os
+import sys
 from typing import Dict, Optional
 
 from .core import runtime as runtime_mod
 from .core.ids import NodeID
+
+
+def chaos_seed(seed: Optional[int] = None) -> int:
+    """Resolve a chaos harness's RNG seed: an explicit ``seed`` wins,
+    else ``RT_CHAOS_SEED`` from the environment, else 0. Every killer
+    logs the resolved value at start so a failing chaos run can be
+    replayed bit-for-bit (same seed -> same victim sequence)."""
+    if seed is not None:
+        return int(seed)
+    return int(os.environ.get("RT_CHAOS_SEED", "0") or 0)
+
+
+def _log_seed(harness: str, seed: int) -> None:
+    print("[rt-chaos] %s seed=%d (explicit seed arg or RT_CHAOS_SEED "
+          "env replays this run)" % (harness, seed), file=sys.stderr,
+          flush=True)
 
 
 class Cluster:
@@ -89,7 +107,8 @@ class NodeKiller:
     """
 
     def __init__(self, cluster: Cluster, kill_interval_s: float = 1.0,
-                 max_kills: Optional[int] = None, seed: int = 0):
+                 max_kills: Optional[int] = None,
+                 seed: Optional[int] = None):
         import random
         import threading
 
@@ -97,7 +116,9 @@ class NodeKiller:
         self.kill_interval_s = kill_interval_s
         self.max_kills = max_kills
         self.killed: list = []
-        self._rng = random.Random(seed)
+        self.seed = chaos_seed(seed)
+        _log_seed("NodeKiller", self.seed)
+        self._rng = random.Random(self.seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -164,7 +185,8 @@ class ReplicaKiller:
     """
 
     def __init__(self, deployment: str, kill_interval_s: float = 1.0,
-                 max_kills: Optional[int] = None, seed: int = 0):
+                 max_kills: Optional[int] = None,
+                 seed: Optional[int] = None):
         import random
         import threading
 
@@ -172,7 +194,9 @@ class ReplicaKiller:
         self.kill_interval_s = kill_interval_s
         self.max_kills = max_kills
         self.killed: list = []  # (actor_id, pid) per kill
-        self._rng = random.Random(seed)
+        self.seed = chaos_seed(seed)
+        _log_seed("ReplicaKiller", self.seed)
+        self._rng = random.Random(self.seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -308,13 +332,22 @@ class HeadKiller:
     def __init__(self, persist_path: str, kill_after_s: float = 0.5,
                  spawn_timeout_s: float = 180.0,
                  env: Optional[Dict[str, str]] = None,
-                 head_src: str = _HEADKILLER_DRIVER_SRC):
+                 head_src: str = _HEADKILLER_DRIVER_SRC,
+                 seed: Optional[int] = None):
+        import random
+
         self.persist_path = persist_path
         self.kill_after_s = kill_after_s
         self.spawn_timeout_s = spawn_timeout_s
         self.killed: list = []
         self._env = dict(env or {})
         self._head_src = head_src
+        # Seeded jitter on the kill point (0.75x-1.25x kill_after_s):
+        # varies WHERE in the workload the SIGKILL lands while keeping
+        # the whole victim sequence replayable from one seed.
+        self.seed = chaos_seed(seed)
+        _log_seed("HeadKiller", self.seed)
+        self._rng = random.Random(self.seed)
 
     def _child_env(self) -> Dict[str, str]:
         import os
@@ -382,7 +415,8 @@ class HeadKiller:
                 % proc.returncode)
         info["total_ms"] = (time.monotonic() - t_spawn) * 1000.0
         if kill:
-            time.sleep(self.kill_after_s)  # let the workload run
+            # let the workload run; seeded jitter moves the kill point
+            time.sleep(self.kill_after_s * self._rng.uniform(0.75, 1.25))
             proc.send_signal(signal.SIGKILL)
             proc.wait()
             self.killed.append(proc.pid)

@@ -115,6 +115,13 @@ class StreamInterruptedError(RuntimeError_):
     of re-dispatching."""
 
 
+class EngineStoppedError(RuntimeError_):
+    """The LLM engine was stopped (or its device loop died) with
+    requests still in flight. Every pending/active RequestHandle is
+    failed with this promptly at ``stop()`` — callers blocked in
+    ``result()`` see a typed error, never a hang past their timeout."""
+
+
 class TaskCancelledError(RuntimeError_):
     """The task was cancelled before or during execution."""
 

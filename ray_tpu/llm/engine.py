@@ -40,14 +40,15 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.exceptions import EngineStoppedError
 from ..models import llama
 from .paged import OverloadedError, PagePool, RadixIndex, llm_metrics
 
@@ -143,6 +144,10 @@ class _Slot:
     eos_id: Optional[int]
     on_token: Optional[Callable[[Optional[int]], None]]
     seed: int = 0  # per-request sampling stream
+    # Chat-session identity: at request finish the engine records the
+    # session's transcript so drain can export it (KV page migration)
+    # and the crash path can re-prefill it elsewhere.
+    session_id: Optional[str] = None
     submit_t: float = 0.0  # monotonic submit time (TTFT + queue timeout)
     # Flight-recorder stamps (monotonic) + measured prefix-match cost:
     # submit -> admit (queue wait) -> first prefill dispatch -> first
@@ -191,6 +196,7 @@ class SlotEngine:
                  prefix_cache: bool = True,
                  max_pending: Optional[int] = None,
                  queue_timeout_s: Optional[float] = None,
+                 max_sessions: int = 256,
                  mesh=None, rules=None):
         if cfg.max_seq % chunk != 0:
             raise ValueError(
@@ -341,6 +347,11 @@ class SlotEngine:
             jax.jit(decode_only_fn, donate_argnums=(1,)))
         self._copy_pages = _maybe_mesh(
             jax.jit(llama.copy_pages, donate_argnums=(0,)))
+        # Session import (page migration): compiled lazily on first use
+        # from the engine thread's control-op slot, where no concurrent
+        # dispatch can be touching the donated cache.
+        self._write_pages = _maybe_mesh(
+            jax.jit(llama.write_pages, donate_argnums=(0,)))
         # Pre-compile the COW page-copy program NOW, while no engine
         # thread can be touching the (donated) cache: the first partial
         # prefix hit must not stall on a compile, and compiling from
@@ -372,6 +383,17 @@ class SlotEngine:
         self._work = threading.Condition(self._lock)
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+        # Resident chat sessions (LRU-bounded): session_id ->
+        # {transcript, seed, temperature, t}. The KV pages themselves
+        # live in the radix index; this is the metadata that lets
+        # export_session find them and the crash path re-prefill.
+        self.max_sessions = max_sessions
+        self._sessions: "OrderedDict[str, dict]" = OrderedDict()
+        # Control ops (export/import/...) run ON THE ENGINE THREAD at a
+        # step boundary: the cache is donated to compiled programs and
+        # mutated by the dispatch path outside the lock, so another
+        # thread must never touch it directly.
+        self._control: deque = deque()
         # counters (observability / autoscaling signals)
         self.tokens_generated = 0
         self.requests_completed = 0
@@ -385,7 +407,8 @@ class SlotEngine:
     def submit(self, prompt: Sequence[int], max_new: int = 64,
                temperature: float = 0.0, eos_id: Optional[int] = None,
                on_token: Optional[Callable[[Optional[int]], None]] = None,
-               seed: Optional[int] = None) -> RequestHandle:
+               seed: Optional[int] = None,
+               session_id: Optional[str] = None) -> RequestHandle:
         prompt = np.asarray(prompt, dtype=np.int32)
         if prompt.ndim != 1 or len(prompt) == 0:
             raise ValueError("prompt must be a non-empty 1D token list")
@@ -404,7 +427,8 @@ class SlotEngine:
         handle = RequestHandle(len(prompt))
         slot = _Slot(handle=handle, prompt=prompt, max_new=max_new,
                      temperature=float(temperature), eos_id=eos_id,
-                     on_token=on_token, submit_t=time.monotonic())
+                     on_token=on_token, submit_t=time.monotonic(),
+                     session_id=session_id)
         with self._work:
             if (self.max_pending is not None
                     and len(self._pending) >= self.max_pending):
@@ -438,6 +462,12 @@ class SlotEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        # Whether or not a thread ever ran (or won the race to drain),
+        # no caller may be left hanging: flush queued control ops and
+        # fail any still-registered request with the typed error.
+        with self._lock:
+            self._drain_control_locked()
+            self._fail_all_locked(EngineStoppedError("engine stopped"))
 
     def warmup(self) -> None:
         """Compile both programs before serving traffic. Safe to call
@@ -482,6 +512,225 @@ class SlotEngine:
             m["pages_used"].set(float(self._pool.used_count))
             m["pages_free"].set(float(self._pool.free_count))
 
+    # -- stateful sessions (migration & drain) -----------------------------
+
+    def sessions(self) -> List[str]:
+        """Resident session ids (insertion/LRU order, oldest first)."""
+        with self._lock:
+            return list(self._sessions.keys())
+
+    @property
+    def session_count(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def _record_session_locked(self, session_id: str, transcript,
+                               seed, temperature: float) -> None:
+        self._sessions[session_id] = {
+            "transcript": np.asarray(transcript, dtype=np.int32),
+            "seed": int(seed or 0) & 0x7FFFFFFF,
+            "temperature": float(temperature),
+            "t": time.monotonic(),
+        }
+        self._sessions.move_to_end(session_id)
+        while len(self._sessions) > self.max_sessions:
+            self._sessions.popitem(last=False)
+        m = llm_metrics()
+        if m is not None:
+            m["sessions_resident"].set(float(len(self._sessions)))
+
+    def _run_control(self, fn, timeout: float = 60.0):
+        """Run ``fn`` under the engine lock ON THE ENGINE THREAD at a
+        step boundary. The KV cache is donated to the compiled programs
+        and reassigned by the dispatch path OUTSIDE the lock, so a
+        foreign thread must never read or write it directly; with no
+        engine thread running the caller becomes the executor."""
+        thread = self._thread
+        if (thread is None or not thread.is_alive()
+                or thread is threading.current_thread()):
+            with self._lock:
+                return fn()
+        box: dict = {}
+        done = threading.Event()
+
+        def op():
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                box["error"] = e
+            finally:
+                done.set()
+
+        with self._work:
+            self._control.append(op)
+            self._work.notify()
+        if not done.wait(timeout):
+            raise TimeoutError("engine control op timed out")
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def export_session(self, session_id: str) -> dict:
+        """Snapshot a session between decode steps: transcript, sampling
+        seed, and the radix-resident KV pages covering its prefix packed
+        page-major into ONE contiguous frame — shipped zero-copy by the
+        object plane (``put_frame`` lays out-of-band buffers 64B-aligned
+        in the frame). Raises KeyError for an unknown session and
+        RuntimeError while the session has a generation in flight."""
+        return self._run_control(
+            lambda: self._export_session_locked(session_id))
+
+    def _export_session_locked(self, session_id: str) -> dict:
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            raise KeyError(f"unknown session {session_id!r}")
+        live = [s for s in self._slots if s is not None]
+        for s in list(self._pending) + live:
+            if s.session_id == session_id:
+                raise RuntimeError(
+                    f"session {session_id!r} has a generation in flight")
+        transcript = sess["transcript"]
+        pages: List[int] = []
+        if self._radix is not None:
+            pages, _ = self._radix.match(transcript)
+        frames = None
+        if pages:
+            idx = np.asarray(pages, dtype=np.int32)
+            # Device gather -> host; pages stay index-owned (we hold
+            # the lock, so no concurrent eviction can free them).
+            frames = np.ascontiguousarray(
+                np.asarray(self._cache["kv"][:, :, idx]))
+        m = llm_metrics()
+        if m is not None:
+            m["session_migrations"].inc(tags={"result": "export"})
+        return {
+            "session_id": session_id,
+            "transcript": np.asarray(transcript, dtype=np.int32),
+            "seed": sess["seed"],
+            "temperature": sess["temperature"],
+            "page_size": self.page_size,
+            "covered_tokens": len(pages) * self.page_size,
+            "pages_kv": frames,
+        }
+
+    def import_session(self, snapshot: dict) -> dict:
+        """Rebuild an exported session on THIS engine: prefix chunks
+        already present in the local radix index are re-matched (COW
+        borrow — never shipped twice), the rest are scattered into
+        freshly allocated pages and filed in the index. Runs out of
+        pool room -> partial import (the uncovered tail simply
+        re-prefills on the session's next turn)."""
+        return self._run_control(
+            lambda: self._import_session_locked(dict(snapshot)))
+
+    def _import_session_locked(self, snap: dict) -> dict:
+        ps = self.page_size
+        m = llm_metrics()
+        try:
+            if int(snap["page_size"]) != ps:
+                raise ValueError(
+                    f"page_size mismatch: snapshot {snap['page_size']} "
+                    f"vs engine {ps}")
+            transcript = np.asarray(snap["transcript"], dtype=np.int32)
+            frames = snap.get("pages_kv")
+            n_chunks = int(snap.get("covered_tokens", 0)) // ps
+            matched: List[int] = []
+            fresh: List[int] = []
+            if (self._radix is not None and n_chunks > 0
+                    and frames is not None):
+                kv_shape = self._cache["kv"].shape
+                if (tuple(frames.shape[:2]) != tuple(kv_shape[:2])
+                        or tuple(frames.shape[3:]) != tuple(kv_shape[3:])):
+                    raise ValueError(
+                        f"KV frame shape {frames.shape} does not match "
+                        f"cache {kv_shape}")
+                matched, _ = self._radix.match(transcript[:n_chunks * ps])
+                need = n_chunks - len(matched)
+                if need > 0 and self._pool.free_count < need:
+                    self._radix.evict(need - self._pool.free_count)
+                fresh = [self._pool.alloc() for _ in
+                         range(min(max(0, need), self._pool.free_count))]
+                if fresh:
+                    have = len(matched)
+                    self._write_frames_locked(
+                        fresh, frames[:, :, have:have + len(fresh)])
+                pages = matched + fresh
+                if pages:
+                    self._radix.insert(transcript[:len(pages) * ps],
+                                       pages)
+                # insert() took the index's own refs on NEW nodes; drop
+                # our allocation refs so the index is the sole owner
+                # and normal LRU eviction applies.
+                for pg in fresh:
+                    self._pool.unref(pg)
+                self._publish_page_gauges()
+            self._record_session_locked(
+                snap["session_id"], transcript, snap.get("seed", 0),
+                snap.get("temperature", 0.0))
+        except Exception:
+            if m is not None:
+                m["session_migrations"].inc(tags={"result": "error"})
+            raise
+        if m is not None:
+            m["session_migrations"].inc(tags={"result": "import"})
+        return {"session_id": snap["session_id"],
+                "pages_imported": len(fresh),
+                "pages_matched": len(matched),
+                "tokens_resident": (len(matched) + len(fresh)) * ps}
+
+    def _write_frames_locked(self, pages: List[int], frames) -> None:
+        """Scatter host KV frames into device pages. N is padded to the
+        next power of two — padding rows aim at the reserved scratch
+        page 0, which absorbs them — so repeated imports compile at
+        most O(log pool) program variants."""
+        n = len(pages)
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        dst = np.zeros((bucket,), dtype=np.int32)
+        dst[:n] = pages
+        vals = np.zeros(frames.shape[:2] + (bucket,) + frames.shape[3:],
+                        dtype=frames.dtype)
+        vals[:, :, :n] = frames[:, :, :n]
+        self._cache = self._write_pages(self._cache, jnp.asarray(dst),
+                                        jnp.asarray(vals))
+
+    def prefill_session(self, session_id: str, transcript,
+                        seed=None, temperature: float = 0.0,
+                        timeout: float = 120.0) -> dict:
+        """Crash-path recovery: rebuild a session the cheap-but-correct
+        way by re-prefilling its transcript (radix hit -> near no-op,
+        cold -> one full prefill). The single sampled token is
+        discarded; the transcript's pages land in the radix index so
+        the session's next turn admits warm. Publishes
+        ``rt_llm_session_recovery_seconds``."""
+        t0 = time.monotonic()
+        toks = np.asarray(transcript, dtype=np.int32)
+        if toks.ndim != 1 or len(toks) == 0:
+            raise ValueError("transcript must be a non-empty token list")
+        toks = toks[:self.cfg.max_seq - 1]
+        h = self.submit(toks, max_new=1,
+                        seed=None if seed is None else int(seed))
+        if self._thread is not None and self._thread.is_alive():
+            h.result(timeout=timeout)
+        else:
+            while not h._done.is_set():
+                if not self.step():
+                    break
+        res = h.result(timeout=0)
+        with self._lock:
+            self._record_session_locked(
+                session_id, np.asarray(transcript, dtype=np.int32),
+                seed, temperature)
+        dt = time.monotonic() - t0
+        m = llm_metrics()
+        if m is not None:
+            m["session_recovery"].observe(dt)
+        return {"session_id": session_id, "seconds": dt,
+                "matched_tokens": (res.timing or {}).get(
+                    "matched_tokens", 0),
+                "transcript_len": int(len(toks))}
+
     # -- engine loop -------------------------------------------------------
 
     def _run(self) -> None:
@@ -490,18 +739,28 @@ class SlotEngine:
                 while not self._stop and not self._has_work_locked():
                     self._work.wait()
                 if self._stop:
-                    self._fail_all_locked(RuntimeError("engine stopped"))
+                    self._drain_control_locked()
+                    self._fail_all_locked(
+                        EngineStoppedError("engine stopped"))
                     return
             try:
                 self.step()
             except Exception as e:  # noqa: BLE001 — device fault is fatal
                 with self._work:
+                    self._drain_control_locked()
                     self._fail_all_locked(e)
                 return
 
     def _has_work_locked(self) -> bool:
         return (bool(self._pending) or self._inflight is not None
+                or bool(self._control)
                 or any(s is not None for s in self._slots))
+
+    def _drain_control_locked(self) -> None:
+        # Control-op wrappers trap their own exceptions into the
+        # caller's result box, so draining never throws.
+        while self._control:
+            self._control.popleft()()
 
     def _release_slot_pages_locked(self, s: _Slot) -> None:
         for pg in s.pages:
@@ -637,7 +896,14 @@ class SlotEngine:
         decode+prefill block, then fetch the PREVIOUS block's tokens
         (ready by now — lag-1 pipelining). Returns True if any work
         ran."""
+        ran_control = False
         with self._lock:
+            # Session export/import and friends run HERE, between
+            # decode steps: the previous block's cache assignment is
+            # complete and the next dispatch hasn't consumed it.
+            while self._control:
+                self._control.popleft()()
+                ran_control = True
             self._shed_expired_locked()
             for i in range(self.num_slots):
                 if self._slots[i] is None and self._pending:
@@ -650,7 +916,7 @@ class SlotEngine:
             active = [(i, s) for i, s in enumerate(self._slots)
                       if s is not None and s.prefill_done
                       and not s.first_tok_pending]
-        ran = False
+        ran = ran_control
         had_fetch = self._inflight is not None
         new_block = (self._dispatch_block(active, prefill_idx)
                      if (active or prefill_idx is not None) else None)
@@ -883,6 +1149,16 @@ class SlotEngine:
                 s.on_token(None)
             self.requests_completed += 1
             with self._lock:
+                if s.session_id is not None:
+                    # Transcript = prompt + everything produced: the
+                    # session's next turn (or its migration target)
+                    # reconstructs from exactly this token list.
+                    self._record_session_locked(
+                        s.session_id,
+                        np.concatenate([
+                            s.prompt,
+                            np.asarray(s.handle._tokens, np.int32)]),
+                        s.seed, s.temperature)
                 self._release_slot_pages_locked(s)
                 self._tables[idx] = 0
                 self._slots[idx] = None

@@ -281,5 +281,22 @@ def llm_metrics() -> Optional[Dict[str, Any]]:
                     Gauge, "rt_llm_decode_steps_per_s",
                     "Steady-state decode steps/s over the current "
                     "roofline window"),
+                # Stateful sessions (migration & drain): residency,
+                # export/import outcomes, and crash-path re-prefill
+                # recovery latency.
+                "sessions_resident": get_or_create(
+                    Gauge, "rt_llm_sessions_resident",
+                    "Chat sessions whose transcript (and usually KV "
+                    "prefix) is resident on this engine"),
+                "session_migrations": get_or_create(
+                    Counter, "rt_llm_session_migrations",
+                    "Session export/import attempts by outcome",
+                    ("result",)),
+                "session_recovery": get_or_create(
+                    Histogram, "rt_llm_session_recovery_seconds",
+                    "Crash-path session recovery latency "
+                    "(transcript re-prefill on the new replica)",
+                    boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                                1.0, 5.0, 30.0]),
             }
         return _llm_metrics_cache

@@ -90,6 +90,7 @@ class LLMServer:
                                  decode_block=decode_block, mesh=mesh)
         self.engine.warmup()  # compile before the replica is routable
         self.engine.start()
+        self._recoveries: list = []  # crash-path restore latencies (ms)
 
     def __del__(self):
         try:
@@ -106,11 +107,19 @@ class LLMServer:
                                      self.default_max_tokens))
         temperature = float(payload.get("temperature", 0.0))
         eos_id = payload.get("eos_id")
+        # Client-pinned seed: a safe retry after replica death replays
+        # the identical request elsewhere; with the seed in the payload
+        # the fold_in sampling stream — and therefore the output — is
+        # bit-for-bit the same on the survivor.
+        seed = payload.get("seed")
+        session_id = payload.get("session")
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         handle = self.engine.submit(
             prompt, max_new=max_tokens, temperature=temperature,
             eos_id=None if eos_id is None else int(eos_id),
+            seed=None if seed is None else int(seed),
+            session_id=None if session_id is None else str(session_id),
             on_token=lambda t: loop.call_soon_threadsafe(q.put_nowait, t))
         if payload.get("stream"):
             # Hold the response until the FIRST token (or failure): the
@@ -144,6 +153,40 @@ class LLMServer:
         return {"tokens": res.tokens, "finish_reason": res.finish_reason,
                 "prompt_len": res.prompt_len, "timing": res.timing}
 
+    # -- stateful sessions (migration & drain, ISSUE 19) -------------------
+
+    def sessions(self) -> list:
+        """Resident session ids on this replica's engine."""
+        return self.engine.sessions()
+
+    def export_sessions(self, session_ids=None) -> list:
+        """Snapshot sessions for migration (controller drain path).
+        Skips ids with a generation currently in flight — the drain
+        quiesce wait retries nothing; those sessions recover via the
+        crash path's re-prefill if they move."""
+        ids = session_ids if session_ids else self.engine.sessions()
+        out = []
+        for sid in ids:
+            try:
+                out.append(self.engine.export_session(sid))
+            except (KeyError, RuntimeError):
+                continue
+        return out
+
+    def import_session(self, snapshot) -> dict:
+        return self.engine.import_session(snapshot)
+
+    def restore_session(self, session_id, transcript, seed=None,
+                        temperature: float = 0.0) -> dict:
+        """Crash-path recovery: re-prefill the transcript (proxy calls
+        this on re-pin when the old replica died without exporting)."""
+        info = self.engine.prefill_session(session_id, transcript,
+                                           seed=seed,
+                                           temperature=temperature)
+        self._recoveries.append(round(info["seconds"] * 1e3, 3))
+        del self._recoveries[:-64]
+        return info
+
     def stats(self) -> dict:
         return {
             "tokens_generated": self.engine.tokens_generated,
@@ -155,6 +198,8 @@ class LLMServer:
             "prefix_tokens_saved": self.engine.prefix_tokens_saved,
             "pages_used": self.engine.pages_used,
             "pages_free": self.engine.pages_free,
+            "sessions_resident": self.engine.session_count,
+            "session_recovery_ms": list(self._recoveries),
             "decode_profile": self.engine.decode_profile(),
         }
 

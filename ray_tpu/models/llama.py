@@ -785,6 +785,18 @@ def copy_pages(cache, src, dst):
     return {"kv": kv.at[:, :, dst].set(kv[:, :, src])}
 
 
+def write_pages(cache, dst, values):
+    """Host->device page import (session migration): physical pages
+    ``dst[i]`` <- ``values[:, :, i]`` across every layer in one program.
+    dst [N] int32; values [L, 2, N, page_size, Hkv, hd] host frames
+    from a peer engine's export. Jit with the cache donated so the
+    import is an in-place scatter; callers pad N to a few fixed bucket
+    sizes (padding rows aimed at the reserved scratch page 0, which
+    absorbs them) so repeated imports never recompile."""
+    kv = cache["kv"]
+    return {"kv": kv.at[:, :, dst].set(values.astype(kv.dtype))}
+
+
 def generate(params, prompt_tokens, cfg: LlamaConfig, max_new: int = 32,
              temperature: float = 0.0, key=None):
     """Greedy/sampled generation (the serve replica's inner loop)."""

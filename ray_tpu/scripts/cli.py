@@ -313,6 +313,14 @@ def cmd_serve(args) -> int:
         serve_api.shutdown()
         print("serve shut down")
         return 0
+    if args.serve_command == "drain":
+        rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
+        from ray_tpu import serve as serve_api
+
+        report = serve_api.drain(args.deployment, replica=args.replica,
+                                 timeout_s=args.timeout_s)
+        print(json.dumps(report, indent=2, default=str))
+        return 0 if report.get("error") is None else 1
     return 2
 
 
@@ -383,6 +391,15 @@ def build_parser() -> argparse.ArgumentParser:
     scp.add_argument("config_file")
     svsub.add_parser("status", help="deployment replica/route status")
     svsub.add_parser("shutdown", help="tear down all deployments")
+    sdr = svsub.add_parser("drain", help="gracefully retire one replica "
+                                         "(migrate sessions, finish "
+                                         "in-flight work, then kill)")
+    sdr.add_argument("deployment")
+    sdr.add_argument("--replica", default=None,
+                     help="actor-id hex of the replica to drain "
+                          "(default: first replica)")
+    sdr.add_argument("--timeout-s", type=float, default=30.0,
+                     dest="timeout_s")
     return p
 
 

@@ -15,6 +15,7 @@ from .api import (
     DeploymentHandle,
     batch,
     deployment,
+    drain,
     get_deployment_handle,
     list_deployments,
     run,
@@ -26,5 +27,6 @@ __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentHandle",
     "DeploymentInfo", "DeploymentSchema", "ServeApplicationSchema",
     "ServeController", "ServeDeploySchema", "batch", "deployment",
-    "get_deployment_handle", "list_deployments", "run", "shutdown", "start",
+    "drain", "get_deployment_handle", "list_deployments", "run",
+    "shutdown", "start",
 ]

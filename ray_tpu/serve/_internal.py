@@ -139,6 +139,70 @@ def _queue_depth_note(name: str, delta: int, gauge=None,
     return total
 
 
+def _session_rendezvous(session_id: str, keys: List[bytes]) -> int:
+    """Rendezvous (highest-random-weight) hash of a session id over
+    replica actor-id keys. Deterministic and order-independent, so
+    EVERY router — and the controller choosing a drain migration
+    target — maps a session to the same surviving replica without any
+    coordination: after a drain or crash the re-pinned replica is
+    exactly the one the sessions were migrated to."""
+    import hashlib
+
+    sid = session_id.encode()
+    best_i = 0
+    best_h = b""
+    for i, k in enumerate(keys):
+        h = hashlib.sha1(sid + k).digest()
+        if h > best_h:
+            best_i, best_h = i, h
+    return best_i
+
+
+class SessionLog:
+    """Head-side bounded transcript log for stateful LLM sessions.
+
+    The proxy appends (transcript, seed) after every successful
+    session-tagged generation. When a session's pinned replica dies
+    WITHOUT exporting (SIGKILL — no drain, no page migration), the
+    re-pinned replica reconstructs the session by re-prefilling this
+    transcript (``restore_session``): cheap when its radix prefix cache
+    hits, correct always. Bounded two ways: whole sessions are evicted
+    LRU past ``max_sessions``, and a transcript is capped at
+    ``max_tokens`` (the resident prefix is what recovery needs; an
+    over-long tail would re-prefill past max_seq anyway)."""
+
+    def __init__(self, max_sessions: int = 512, max_tokens: int = 8192):
+        from collections import OrderedDict
+
+        self.max_sessions = max_sessions
+        self.max_tokens = max_tokens
+        self._entries: "Dict[tuple, dict]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def note(self, deployment: str, session_id: str, transcript,
+             seed=None, temperature: float = 0.0) -> None:
+        toks = [int(t) for t in transcript][: self.max_tokens]
+        with self._lock:
+            self._entries[(deployment, session_id)] = {
+                "transcript": toks,
+                "seed": None if seed is None else int(seed),
+                "temperature": float(temperature),
+                "t": time.monotonic(),
+            }
+            self._entries.move_to_end((deployment, session_id))
+            while len(self._entries) > self.max_sessions:
+                self._entries.popitem(last=False)
+
+    def get(self, deployment: str, session_id: str) -> Optional[dict]:
+        with self._lock:
+            entry = self._entries.get((deployment, session_id))
+            return None if entry is None else dict(entry)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
 @dataclass
 class AutoscalingConfig:
     """Reference: serve/config.py AutoscalingConfig."""
@@ -508,7 +572,11 @@ class _Replica:
         return True, items
 
     def metrics(self):
-        return {"ongoing": self._ongoing, "total": self._total}
+        # "streams" lets the controller's drain verb wait for handed-off
+        # streaming responses (no longer "ongoing") to finish before the
+        # replica is terminated — killing earlier severs them mid-stream.
+        return {"ongoing": self._ongoing, "total": self._total,
+                "streams": len(self._streams)}
 
     def reconfigure(self, user_config):
         if hasattr(self.callable, "reconfigure"):
@@ -536,6 +604,9 @@ class ServeController:
         # {"probe": outstanding ref|None, "sent": ts, "fails": n,
         #  "ok": answered-at-least-once}. See _health_sweep_locked.
         self._health: Dict[str, Dict[bytes, dict]] = {}
+        # Replicas removed from the routable set by drain() but still
+        # alive finishing in-flight work; killed once quiescent.
+        self._draining: Dict[str, List[Any]] = {}
         self._metrics: Dict[str, List[float]] = {}
         self._last_scale_up: Dict[str, float] = {}
         self._last_scale_down: Dict[str, float] = {}
@@ -587,6 +658,7 @@ class ServeController:
         with self._lock:
             info = self.deployments.pop(name, None)
             victims = self.replicas.pop(name, [])
+            victims += self._draining.pop(name, [])
             self._health.pop(name, None)
             self._bump_locked(name)
         metrics = serve_metrics()
@@ -598,6 +670,125 @@ class ServeController:
             except Exception:
                 pass
         return info is not None
+
+    # -- graceful drain (ISSUE 19) -------------------------------------------
+    def drain(self, name: str, replica_actor_id: Optional[str] = None,
+              timeout_s: float = 30.0, migrate: bool = True) -> dict:
+        """Gracefully remove ONE replica: stop new assignments (routers
+        learn on the next long-poll push; target-count reconciliation
+        spawns the replacement), migrate resident LLM sessions to the
+        surviving replicas they will re-pin to (same rendezvous hash
+        the routers use), let in-flight requests AND handed-off streams
+        finish, then terminate. Zero dropped requests, zero 503s
+        attributable to the drain — the stateful counterpart to the
+        health sweep's kill-and-replace."""
+        t0 = time.monotonic()
+        report: dict = {"deployment": name, "sessions_migrated": 0,
+                        "migrate_errors": 0, "migrate_ms": [],
+                        "sessions": [], "timed_out": False}
+        with self._lock:
+            current = self.replicas.get(name, [])
+            victim = None
+            if replica_actor_id is None:
+                victim = current[0] if current else None
+            else:
+                for r in current:
+                    if r._actor_id.hex() == replica_actor_id:
+                        victim = r
+                        break
+            if victim is None:
+                report["error"] = (f"no such replica in deployment "
+                                   f"{name!r}")
+                return report
+            current.remove(victim)
+            self._health.get(name, {}).pop(victim._actor_id.binary(),
+                                           None)
+            self._draining.setdefault(name, []).append(victim)
+            report["replica"] = victim._actor_id.hex()
+            self._bump_locked(name)
+        # Let reconciliation register the replacement handle before
+        # choosing migration targets: the rendezvous set must match
+        # what routers will re-pin against (calls on a replica still
+        # constructing queue in its mailbox, so import can proceed).
+        target_wait = min(5.0, timeout_s / 2)
+        while time.monotonic() - t0 < target_wait:
+            with self._lock:
+                info = self.deployments.get(name)
+                have = len(self.replicas.get(name, []))
+                want = self._target_replicas(name) if info else 0
+            if have >= want or have == 0:
+                break
+            time.sleep(0.05)
+        if migrate:
+            self._migrate_sessions(name, victim, report,
+                                   deadline=t0 + timeout_s)
+        # Quiesce: both the request counter and handed-off streams must
+        # reach zero on a few consecutive polls (a request may be
+        # between router assignment and handle_request entry).
+        zero_polls = 0
+        while time.monotonic() - t0 < timeout_s:
+            try:
+                m = get(victim.metrics.remote(), timeout=5)
+            except Exception:
+                break  # already dead: nothing left to wait for
+            if m.get("ongoing", 0) <= 0 and m.get("streams", 0) <= 0:
+                zero_polls += 1
+                if zero_polls >= 3:
+                    break
+            else:
+                zero_polls = 0
+            time.sleep(0.05)
+        else:
+            report["timed_out"] = True
+        report["drained_ms"] = round((time.monotonic() - t0) * 1e3, 3)
+        try:
+            kill(victim)
+        except Exception:
+            pass
+        with self._lock:
+            lst = self._draining.get(name, [])
+            if victim in lst:
+                lst.remove(victim)
+        return report
+
+    def _migrate_sessions(self, name: str, victim, report: dict,
+                          deadline: float) -> None:
+        """Export every resident session from the draining replica and
+        import each into the surviving replica its id rendezvous-hashes
+        to. Deployments without session methods (anything that isn't an
+        LLM server) drain without migration."""
+        try:
+            snaps = get(victim.call_method.remote("export_sessions",
+                                                  (), {}),
+                        timeout=max(5.0, deadline - time.monotonic()))
+        except Exception as e:  # noqa: BLE001 — non-LLM deployment
+            report["export_skipped"] = repr(e)[:200]
+            return
+        if not snaps:
+            return
+        with self._lock:
+            targets = list(self.replicas.get(name, []))
+        if not targets:
+            report["migrate_errors"] = len(snaps)
+            report["export_skipped"] = "no surviving replicas"
+            return
+        keys = [r._actor_id.binary() for r in targets]
+        for snap in snaps:
+            sid = snap.get("session_id")
+            tgt = targets[_session_rendezvous(str(sid), keys)]
+            t1 = time.monotonic()
+            try:
+                get(tgt.call_method.remote("import_session", (snap,),
+                                           {}),
+                    timeout=max(5.0, deadline - time.monotonic()))
+                report["sessions_migrated"] += 1
+                report["migrate_ms"].append(
+                    round((time.monotonic() - t1) * 1e3, 3))
+                report["sessions"].append(sid)
+            except Exception as e:  # noqa: BLE001 — keep draining
+                report["migrate_errors"] += 1
+                report.setdefault("migrate_error_detail",
+                                  repr(e)[:200])
 
     # -- long-poll config push ----------------------------------------------
     def listen_for_change(self, name: str, known_version: int,
@@ -913,6 +1104,11 @@ class Router:
         # next_chunks from the replica that holds the stream, not the
         # dead one originally picked.
         self._retried_replica: Dict[bytes, Any] = {}
+        # session id -> pinned replica key (sticky routing). Lazy
+        # re-pin: a pin whose replica left the set is re-resolved with
+        # the rendezvous hash on next use — the same hash the
+        # controller's drain verb used to place the migrated sessions.
+        self._sticky: Dict[str, bytes] = {}
         self._waiters = 0  # blocked assigners; gate for notify_all
         self._lock = threading.Lock()
         self._slot_free = threading.Condition(self._lock)
@@ -1395,6 +1591,106 @@ class Router:
         finally:
             if queued:
                 _pending_note(self._name, -1)
+
+    # -- sticky sessions (ISSUE 19) ------------------------------------------
+    def _pick_session_locked(self, session_id: str):
+        """Under self._slot_free: resolve the session's pinned replica
+        (rendezvous hash on first use or after its replica left the
+        set) and take one slot on it. Returns (replica, key, rerouted)
+        or None when the pinned replica is at capacity — session
+        affinity means we WAIT for its slot rather than spill the
+        session's KV-cache locality to a cold replica."""
+        n = len(self._replicas)
+        if n == 0:
+            return None
+        key = self._sticky.get(session_id)
+        rerouted = False
+        if key is not None and key not in set(self._keys):
+            rerouted = True  # pinned replica drained or crashed
+            key = None
+        if key is None:
+            if len(self._sticky) > 4096:
+                self._sticky.clear()
+            key = self._keys[_session_rendezvous(session_id, self._keys)]
+            self._sticky[session_id] = key
+        idx = self._keys.index(key)
+        load = self._inflight.get(key, 0)
+        if load >= self._max_cq:
+            return None
+        self._inflight[key] = load + 1
+        self._note_inflight(1)
+        return self._replicas[idx], key, rerouted
+
+    def acquire_session_slot(self, session_id: str,
+                             deadline: Optional[float] = None):
+        """Two-phase session assign, step 1: pin (or re-pin) the
+        session's replica and reserve one slot on it, WITHOUT
+        submitting. Returns (replica, key, rerouted, deadline). The
+        caller restores crashed sessions on reroute before submitting
+        with ``submit_on``; on failure in between it must give the slot
+        back via ``release_slot``. Blocking/shedding semantics match
+        assign_with_replica (typed 503/504)."""
+        self._ensure_replicas()
+        deadline, queue_deadline = self._deadlines(deadline)
+        queued = False
+        try:
+            while True:
+                with self._slot_free:
+                    got = self._pick_session_locked(session_id)
+                    if got is not None:
+                        replica, key, rerouted = got
+                        return replica, key, rerouted, deadline
+                    now = time.monotonic()
+                    if deadline is not None and now >= deadline:
+                        self._count_deadline()
+                        raise DeadlineExceededError(
+                            f"session request to {self._name!r} "
+                            f"exceeded its deadline while queued")
+                    if now >= queue_deadline:
+                        raise self._overloaded()
+                    queued = self._admit_locked(queued)
+                    self._waiters += 1
+                    try:
+                        self._slot_free.wait(
+                            min(queue_deadline - now, 1.0))
+                    finally:
+                        self._waiters -= 1
+                self._ensure_replicas()
+        finally:
+            if queued:
+                _pending_note(self._name, -1)
+
+    def submit_on(self, replica, key, method, args, kwargs,
+                  deadline: Optional[float] = None):
+        """Two-phase session assign, step 2: submit on the slot taken
+        by acquire_session_slot. Rides _submit, so the safe-retry
+        interceptor still re-dispatches if the pinned replica dies
+        before any response byte (re-prefill recovery makes the retried
+        request bit-for-bit correct on the survivor)."""
+        return self._submit(replica, key, method, args, kwargs, deadline)
+
+    def release_slot(self, key: bytes) -> None:
+        """Give back a slot reserved by acquire_session_slot that was
+        never submitted (restore failed, caller bailed)."""
+        self._release(key)
+
+    def session_replica(self, session_id: str):
+        """Diagnostics: the session's pinned replica key hex, or None."""
+        with self._slot_free:
+            key = self._sticky.get(session_id)
+            return None if key is None else key.hex()
+
+    def assign_session(self, method: Optional[str], args, kwargs,
+                       session_id: str,
+                       deadline: Optional[float] = None):
+        """One-call sticky assign (handle path): acquire + submit.
+        Returns (ref, replica, rerouted)."""
+        replica, key, rerouted, deadline = self.acquire_session_slot(
+            session_id, deadline)
+        # _submit gives the slot back itself if the dispatch raises.
+        ref, replica = self.submit_on(replica, key, method, args,
+                                      kwargs, deadline)
+        return ref, replica, rerouted
 
     def try_assign_batch(self, items, deadline: Optional[float] = None):
         """Assign a COALESCED batch to ONE replica in a single actor

@@ -32,6 +32,7 @@ from ._internal import (
     DeploymentInfo,
     Router,
     ServeController,
+    SessionLog,
     serve_metrics,
 )
 
@@ -162,6 +163,19 @@ def _controller():
     return _state["controller"]
 
 
+def drain(deployment: str, replica: Optional[str] = None,
+          timeout_s: float = 30.0) -> dict:
+    """Gracefully drain one replica of ``deployment``: new requests stop
+    routing to it, resident LLM sessions migrate (KV pages + transcript)
+    to the surviving replicas they will re-pin to, in-flight requests
+    and streams finish, then the replica is terminated and reconciled
+    away. ``replica`` is an actor-id hex (first replica when None).
+    Returns the controller's drain report (sessions migrated, per-
+    session migration latency, total drain time)."""
+    return get(_controller().drain.remote(deployment, replica, timeout_s),
+               timeout=timeout_s + 90)
+
+
 def _is_stream_marker(value) -> bool:
     return (isinstance(value, tuple) and len(value) == 2
             and value[0] == "__rt_stream__")
@@ -258,10 +272,35 @@ class DeploymentHandle:
     def method(self, method_name: str) -> "DeploymentMethodHandle":
         return DeploymentMethodHandle(self, method_name)
 
+    def session(self, session_id: str) -> "SessionHandle":
+        """Sticky-session view of this handle: every ``.remote()`` call
+        routes to the one replica the session id pins (rendezvous hash
+        over the live replica set), keeping its KV-cache locality.
+        HTTP clients get the same affinity via the ``x-serve-session``
+        header."""
+        return SessionHandle(self, session_id)
+
     def __getattr__(self, item):
         if item.startswith("_"):
             raise AttributeError(item)
         return DeploymentMethodHandle(self, item)
+
+
+class SessionHandle:
+    """Session-pinned caller (see DeploymentHandle.session)."""
+
+    def __init__(self, handle: DeploymentHandle, session_id: str):
+        self._handle = handle
+        self._session_id = session_id
+
+    def remote(self, *args, **kwargs):
+        ref, _replica, _rerouted = self._handle._router.assign_session(
+            None, args, kwargs, self._session_id)
+        return ref
+
+    def replica_key(self) -> Optional[str]:
+        """Hex actor-id key the session is currently pinned to."""
+        return self._handle._router.session_replica(self._session_id)
 
 
 class DeploymentMethodHandle:
@@ -517,6 +556,9 @@ class _AsyncHTTPProxy:
         # added latency for a lone request (batch of 1 goes immediately).
         self._pending: Dict[str, Any] = {}
         self._draining: set = set()
+        # Crash-recovery transcript log for x-serve-session requests
+        # (drain migrates pages; SIGKILL recovery re-prefills from here).
+        self._session_log = SessionLog()
         self._loop = asyncio.new_event_loop()
         self._server = None
         self._started = threading.Event()
@@ -600,6 +642,60 @@ class _AsyncHTTPProxy:
             self._draining.add(name)
             asyncio.ensure_future(self._drain_pending(name, handle))
         return await fut
+
+    async def _submit_session(self, name: str, handle, args, sid: str,
+                              deadline: Optional[float] = None):
+        """Sticky-session submit path (x-serve-session): bypasses the
+        coalescer — the slot is reserved on the session's PINNED
+        replica first (two-phase), and when that pin had to move
+        (pinned replica drained or crashed) the session is restored on
+        the new replica from the head-side transcript log BEFORE the
+        request runs. acquire_session_slot can block on the pinned
+        replica's capacity, so it runs off-loop."""
+        import asyncio
+
+        router = handle._router
+        loop = self._loop
+        replica, key, rerouted, eff_deadline = await loop.run_in_executor(
+            None, lambda: router.acquire_session_slot(sid, deadline))
+        if rerouted:
+            entry = self._session_log.get(name, sid)
+            if entry is not None:
+                try:
+                    await self._aget(
+                        replica.call_method.remote(
+                            "restore_session",
+                            (sid, entry["transcript"], entry["seed"],
+                             entry.get("temperature", 0.0)), {}, None),
+                        120)
+                except Exception:
+                    # Best-effort: a deployment without restore_session
+                    # (or a failed re-prefill) still serves the request
+                    # — the engine simply prefills cold.
+                    pass
+        # submit_on's _submit gives the slot back itself on a raise.
+        ref, _ = router.submit_on(replica, key, None, args, {},
+                                  eff_deadline)
+        timeout = 60.0
+        if eff_deadline is not None:
+            timeout = max(0.0, eff_deadline - time.monotonic()) + 2.0
+        result = await self._aget(ref, timeout)
+        replica = router.replica_for(ref, replica)
+        return result, replica
+
+    def _note_session(self, name: str, sid: str, payload,
+                      result) -> None:
+        """After a successful session-tagged generation: append the
+        conversation state (prompt + produced tokens) to the bounded
+        transcript log the crash path recovers from."""
+        if not (isinstance(result, dict) and
+                isinstance(result.get("tokens"), list) and
+                isinstance(payload, dict) and
+                isinstance(payload.get("prompt"), list)):
+            return
+        self._session_log.note(
+            name, sid, list(payload["prompt"]) + list(result["tokens"]),
+            payload.get("seed"), float(payload.get("temperature", 0.0)))
 
     async def _drain_pending(self, name: str, handle):
         import asyncio
@@ -798,9 +894,21 @@ class _AsyncHTTPProxy:
             if handle is None:
                 handle = DeploymentHandle(name)
                 self._handles[name] = handle
-            args = () if payload is None else (payload,)
-            result, replica = await self._submit_coalesced(
-                name, handle, args, deadline)
+            sid = (headers or {}).get("x-serve-session")
+            if sid:
+                # Sticky session: tag the payload (the LLM server
+                # records residency under this id) and take the pinned
+                # two-phase path instead of the coalescer.
+                if isinstance(payload, dict):
+                    payload.setdefault("session", sid)
+                args = () if payload is None else (payload,)
+                result, replica = await self._submit_session(
+                    name, handle, args, sid, deadline)
+                self._note_session(name, sid, payload, result)
+            else:
+                args = () if payload is None else (payload,)
+                result, replica = await self._submit_coalesced(
+                    name, handle, args, deadline)
         except Exception as e:  # noqa: BLE001
             # No cache surgery here: an application-level 500 says
             # nothing about routes, and the TTL already bounds how long

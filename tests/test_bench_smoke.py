@@ -94,6 +94,31 @@ def test_bench_smoke_runs_all_stages():
     assert sess["warm_ttft_speedup"] >= 1.5, sess
     assert sess["prefix_tokens_saved"] > 0, sess
 
+    # Stateful-session chaos stage (ISSUE 19): drain mid-traffic AND
+    # SIGKILL mid-generation — sessions migrate (KV page export/import)
+    # or recover (transcript re-prefill), continuations stay bit-for-bit
+    # (greedy AND seeded), and no request is dropped: zero raw 500s,
+    # zero hangs, zero drain-caused 503s.
+    assert "llm_drain_error" not in result, result
+    ld = result["llm_drain"]
+    assert ld["drain"]["error"] is None, ld
+    assert ld["drain"]["sessions_migrated"] >= 1, ld
+    assert ld["drain"]["migrate_errors"] == 0, ld
+    assert ld["drain"]["timed_out"] is False, ld
+    assert ld["kills"] >= 1, ld
+    dcounts = ld["counts"]
+    assert dcounts["raw_500"] == 0, ld
+    assert dcounts["hung"] == 0, ld
+    assert dcounts["other"] == 0, ld
+    assert dcounts["ok"] > 0, ld
+    assert ld["drain_503"] == 0, ld
+    assert ld["parity_greedy"] is True, ld
+    assert ld["parity_seeded"] is True, ld
+    assert ld["migrate_ms_p50"] > 0, ld
+    assert ld["migrate_ms_p99"] >= ld["migrate_ms_p50"], ld
+    assert ld["recovery_samples"] >= 1, ld
+    assert ld["recovery_ms_p50"] > 0, ld
+
     # Long-gen decode + roofline stage (ISSUE 17): sustained decode
     # tok/s with the decode block committed next to the roofline
     # fraction, plus the tp2 parity sub-stage — under the test env's

@@ -10,6 +10,8 @@ import time
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.chaos
+
 
 def test_tasks_survive_node_killer(rt_cluster):
     import ray_tpu as rt

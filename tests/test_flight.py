@@ -274,6 +274,7 @@ while True:
 """
 
 
+@pytest.mark.chaos
 @pytest.mark.skipif(not build_native(),
                     reason="native toolchain unavailable")
 def test_flight_survives_head_failover(tmp_path):

@@ -20,8 +20,11 @@ import pytest
 
 from ray_tpu.core.gcs_socket import build_native
 
-pytestmark = pytest.mark.skipif(
-    not build_native(), reason="native toolchain unavailable")
+pytestmark = [
+    pytest.mark.chaos,
+    pytest.mark.skipif(
+        not build_native(), reason="native toolchain unavailable"),
+]
 
 
 # Driver script for the basic failover cycle: creates a named actor and

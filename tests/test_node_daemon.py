@@ -96,6 +96,7 @@ def test_placement_group_across_daemons(daemon_cluster):
     rt.remove_placement_group(pg)
 
 
+@pytest.mark.chaos
 def test_daemon_chaos_sigkill_retries():
     """SIGKILL one daemon mid-workload: driver sees EOF, fails the node,
     and retries/reconstructs so the workload still completes."""
