@@ -141,7 +141,20 @@ class ActorHandle:
             max_retries=self._max_task_retries,
             name=f"{self._name or 'actor'}.{method_name}",
         )
-        refs = rt.submit_spec(spec)
+        # Same trace stamping as the task submit path
+        # (remote_function.py): actor method calls were the one submit
+        # path that dropped the caller's context, so an actor-mediated
+        # hop broke the request trace. Both frame encodings carry it —
+        # the generic payload dict and aexec slot 7.
+        from ..observability import tracing
+
+        if tracing.get_tracer().enabled:
+            with tracing.span(f"actor.submit {spec.name}",
+                              task_id=spec.task_id.hex()):
+                spec.trace_ctx = tracing.inject_context()
+                refs = rt.submit_spec(spec)
+        else:
+            refs = rt.submit_spec(spec)
         if num_returns == 1:
             return refs[0]
         return refs
@@ -237,7 +250,15 @@ class ActorClass:
             name=opts["name"] or "",
             runtime_env=dict(opts["runtime_env"]) if opts.get("runtime_env") else None,
         )
-        rt.submit_spec(spec)
+        from ..observability import tracing
+
+        if tracing.get_tracer().enabled:
+            with tracing.span(f"actor.create {self._cls.__name__}",
+                              task_id=spec.task_id.hex()):
+                spec.trace_ctx = tracing.inject_context()
+                rt.submit_spec(spec)
+        else:
+            rt.submit_spec(spec)
         handle = ActorHandle(
             actor_id, self._method_table(),
             max_task_retries=opts["max_task_retries"],

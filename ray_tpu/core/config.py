@@ -141,6 +141,19 @@ _define("worker_redirect_logs", bool, True,
         "Redirect worker stdout/stderr to session log files tailed by "
         "the log monitor.")
 _define("metrics_report_interval_ms", int, 1000, "Metrics flush interval.")
+_define("trace_sample_rate", float, 1.0,
+        "Head-side trace sampling: fraction of trace ids the trace store "
+        "indexes (deterministic on the trace id, so every span of a "
+        "request shares one verdict). Slow/errored traces are kept "
+        "regardless via tail-based retention. 1.0 keeps everything.")
+_define("trace_store_max_traces", int, 2048,
+        "Bounded LRU capacity of the head trace store (distinct trace "
+        "ids); evictions are counted in "
+        "rt_telemetry_dropped_total{buffer=tracestore}.")
+_define("trace_slow_ms", float, 250.0,
+        "Tail-retention threshold: a span at least this long (or any "
+        "errored span) promotes its sampled-out trace into the store, "
+        "so tail exemplars survive head sampling.")
 _define("telemetry_enabled", bool, True,
         "Cluster telemetry plane: runtime metric instrumentation plus "
         "per-process metric-delta/span shipping to the head every "

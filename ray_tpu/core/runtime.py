@@ -181,6 +181,16 @@ class Runtime:
         self._flight_on = _flight.enabled()
         if self._flight_on:
             _flight.clear()
+        # Head trace store: same replacement-head rule as the flight
+        # recorder (start clean, never inherit a dead head's traces),
+        # plus the tracer sink that routes HEAD-local spans (proxy,
+        # router — this process has no TelemetryExporter) into the
+        # per-request index that `rt trace` queries.
+        if config().telemetry_enabled:
+            from ..observability import tracestore as _tracestore
+
+            _tracestore.clear()
+            _tracestore.install_head_sink()
         # Session log dir: workers redirect stdout/stderr there; the log
         # monitor tails the files and republishes to the driver
         # (reference: log_monitor.py + session_latest/logs layout).

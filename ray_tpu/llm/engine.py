@@ -148,6 +148,9 @@ class _Slot:
     # session's transcript so drain can export it (KV page migration)
     # and the crash path can re-prefill it elsewhere.
     session_id: Optional[str] = None
+    # (trace_id, parent_span_id) propagated from the serve request; at
+    # finish the stage stamps below become child spans on that trace.
+    trace_ctx: Optional[tuple] = None
     submit_t: float = 0.0  # monotonic submit time (TTFT + queue timeout)
     # Flight-recorder stamps (monotonic) + measured prefix-match cost:
     # submit -> admit (queue wait) -> first prefill dispatch -> first
@@ -408,7 +411,8 @@ class SlotEngine:
                temperature: float = 0.0, eos_id: Optional[int] = None,
                on_token: Optional[Callable[[Optional[int]], None]] = None,
                seed: Optional[int] = None,
-               session_id: Optional[str] = None) -> RequestHandle:
+               session_id: Optional[str] = None,
+               trace_ctx: Optional[tuple] = None) -> RequestHandle:
         prompt = np.asarray(prompt, dtype=np.int32)
         if prompt.ndim != 1 or len(prompt) == 0:
             raise ValueError("prompt must be a non-empty 1D token list")
@@ -424,11 +428,17 @@ class SlotEngine:
             raise ValueError(
                 f"request needs {n_total} KV pages but the pool only "
                 f"has {self._num_pages - 1} allocatable")
+        if trace_ctx is None:
+            # Direct submits (no serve hop) still join a caller's trace
+            # when one is open on this thread / task.
+            from ..observability import tracing
+
+            trace_ctx = tracing.inject_context()
         handle = RequestHandle(len(prompt))
         slot = _Slot(handle=handle, prompt=prompt, max_new=max_new,
                      temperature=float(temperature), eos_id=eos_id,
                      on_token=on_token, submit_t=time.monotonic(),
-                     session_id=session_id)
+                     session_id=session_id, trace_ctx=trace_ctx)
         with self._work:
             if (self.max_pending is not None
                     and len(self._pending) >= self.max_pending):
@@ -1077,6 +1087,44 @@ class SlotEngine:
             m["decode_per_token"].observe(timing["decode_per_token_s"])
         return timing
 
+    def _emit_trace_spans(self, s: _Slot, timing: dict) -> None:
+        """Turn the finished request's `timing` stage breakdown into
+        child spans on its propagated trace: an ``llm.request`` span
+        parented to the serve request, with admission/queue/prefix_match/
+        prefill/decode children laid out from the SAME durations the
+        timing dict reports (so span tree and `timing` metadata agree by
+        construction). Stamps are monotonic; the wall offset lines them
+        up with proxy/replica spans within clock-sampling noise."""
+        from ..observability import tracing
+
+        if not tracing.get_tracer().enabled:
+            return
+        off = time.time() - time.monotonic()
+        t0 = s.submit_t + off
+        trace_id, parent = s.trace_ctx
+        root = tracing.record_span(
+            "llm.request", trace_id=trace_id, parent_id=parent,
+            start_s=t0, end_s=t0 + timing["total_s"],
+            prompt_len=int(len(s.prompt)), produced=int(s.produced),
+            matched_tokens=int(s.matched_len))
+        if root is None:
+            return
+        cur = t0
+        for stage in ("admission", "queue", "prefill", "decode"):
+            dur = timing[f"{stage}_s"]
+            tracing.record_span(f"llm.{stage}", trace_id=trace_id,
+                                parent_id=root.span_id, start_s=cur,
+                                end_s=cur + dur)
+            cur += dur
+        if timing["prefix_match_s"] > 0.0:
+            # Overlaps the queue->prefill boundary (the match runs at
+            # admission into the prefill lane); rendered as its own
+            # child rather than folded into either stage.
+            match_t0 = t0 + timing["admission_s"] + timing["queue_s"]
+            tracing.record_span("llm.prefix_match", trace_id=trace_id,
+                                parent_id=root.span_id, start_s=match_t0,
+                                end_s=match_t0 + timing["prefix_match_s"])
+
     def reset_decode_profile(self) -> None:
         """Zero the roofline window. Successive bench stages call this
         between phases so each measures its OWN steady-state interval —
@@ -1122,8 +1170,14 @@ class SlotEngine:
                 "roofline_frac": (achieved_gbps / peak_gbps
                                   if peak_gbps > 0 else 0.0),
             }
+        # Publish only MEASURED windows: an idle engine's stats() call
+        # (zero steps since the last reset) would ship a 0.0 gauge that
+        # overwrites another process's live roofline on the head —
+        # last-writer-wins gauge merge — so the scrape-time value raced
+        # with whichever engine happened to flush last. The gauges read
+        # as "last measured decode window" cluster-wide.
         m = llm_metrics()
-        if m is not None:
+        if m is not None and steps > 0:
             m["roofline_frac"].set(prof["roofline_frac"])
             m["decode_steps"].set(prof["steps_per_s"])
         return prof
@@ -1132,9 +1186,11 @@ class SlotEngine:
         s.last_token = tok
         s.produced += 1
         self.tokens_generated += 1
+        m = llm_metrics()
+        if m is not None:
+            m["tokens"].inc(1.0)
         if s.produced == 1:
             s.first_tok_t = time.monotonic()
-            m = llm_metrics()
             if m is not None:
                 m["ttft"].observe(s.first_tok_t - s.submit_t)
         s.handle._emit(tok)
@@ -1144,6 +1200,8 @@ class SlotEngine:
         out_of_room = (len(s.prompt) + s.produced) >= self.cfg.max_seq
         if hit_eos or s.produced >= s.max_new or out_of_room:
             s.handle.timing = self._request_timing(s)
+            if s.trace_ctx is not None:
+                self._emit_trace_spans(s, s.handle.timing)
             s.handle._finish("stop" if hit_eos else "length")
             if s.on_token:
                 s.on_token(None)

@@ -281,6 +281,13 @@ def llm_metrics() -> Optional[Dict[str, Any]]:
                     Gauge, "rt_llm_decode_steps_per_s",
                     "Steady-state decode steps/s over the current "
                     "roofline window"),
+                # Monotone token production: the rate source behind the
+                # history ring's tok/s series (`rt top`); a gauge of
+                # engine.tokens_generated would reset on replica
+                # replacement and fake a negative rate.
+                "tokens": get_or_create(
+                    Counter, "rt_llm_tokens_generated_total",
+                    "Decode tokens produced (all requests)"),
                 # Stateful sessions (migration & drain): residency,
                 # export/import outcomes, and crash-path re-prefill
                 # recovery latency.

@@ -115,12 +115,18 @@ class LLMServer:
         session_id = payload.get("session")
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
+        from ..observability import tracing
+
         handle = self.engine.submit(
             prompt, max_new=max_tokens, temperature=temperature,
             eos_id=None if eos_id is None else int(eos_id),
             seed=None if seed is None else int(seed),
             session_id=None if session_id is None else str(session_id),
-            on_token=lambda t: loop.call_soon_threadsafe(q.put_nowait, t))
+            on_token=lambda t: loop.call_soon_threadsafe(q.put_nowait, t),
+            # The replica bound the request's trace ctx to THIS asyncio
+            # task (handle_request); hand it to the engine thread so the
+            # stage spans it synthesizes at finish join the same trace.
+            trace_ctx=tracing.get_request_context())
         if payload.get("stream"):
             # Hold the response until the FIRST token (or failure): the
             # proxy writes the chunked 200 header as soon as it sees a

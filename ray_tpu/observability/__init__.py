@@ -13,7 +13,18 @@ from .flight import (
 )
 from .metrics import Counter, Gauge, Histogram, core_metrics, registry
 from .event_stats import EventStats, global_event_stats
-from .telemetry import TelemetryExporter, refresh_cluster_gauges
+from .telemetry import (
+    TelemetryExporter,
+    history,
+    record_history_sample,
+    refresh_cluster_gauges,
+)
+from .tracestore import (
+    format_trace_tree,
+    slow_traces,
+    trace_detail,
+    trace_list,
+)
 from .state import (
     actor_detail,
     cluster_status,
@@ -33,10 +44,13 @@ __all__ = [
     "Counter", "Dashboard", "EventLog", "EventStats", "Gauge",
     "Histogram", "Severity", "actor_detail",
     "cluster_status", "core_metrics", "emit", "event_loop_stats",
-    "flight_summary", "format_flight_summary", "recent_flight_tasks",
+    "flight_summary", "format_flight_summary", "format_trace_tree",
+    "history", "recent_flight_tasks",
     "global_event_log", "global_event_stats",
     "list_actors", "list_nodes", "list_objects", "list_placement_groups",
-    "list_tasks", "list_workers", "record_span", "refresh_cluster_gauges",
-    "registry", "start_dashboard", "stop_dashboard", "summarize_tasks",
-    "TelemetryExporter", "timeline",
+    "list_tasks", "list_workers", "record_history_sample", "record_span",
+    "refresh_cluster_gauges",
+    "registry", "slow_traces", "start_dashboard", "stop_dashboard",
+    "summarize_tasks", "TelemetryExporter", "timeline",
+    "trace_detail", "trace_list",
 ]

@@ -23,6 +23,23 @@ from .events import global_event_log
 from .metrics import registry
 
 
+def _trace_index() -> dict:
+    """``/api/traces``: trace-store summaries + store stats."""
+    from . import tracestore
+
+    return {"stats": tracestore.stats(),
+            "traces": tracestore.list_traces(limit=200)}
+
+
+def _trace_one(trace_id: str):
+    """``/api/traces/<id>``: one request's span tree (prefix ok)."""
+    from . import tracestore
+
+    data = tracestore.get_trace(trace_id)
+    return data if data is not None else {"error": "unknown trace",
+                                          "trace_id": trace_id}
+
+
 def _serve_status() -> dict:
     """``/api/serve``: deployment/router snapshot (reference: the serve
     dashboard module). Lazy import — serve may never have been loaded."""
@@ -138,15 +155,25 @@ async function fetchJson(path){
   return res.json();
 }
 async function renderOverview(){
-  const [summary, stats, nodes] = await Promise.all([
+  const [summary, stats, nodes, history] = await Promise.all([
     fetchJson("/api/summary"), fetchJson("/api/node_stats"),
-    fetchJson("/api/nodes")]);
+    fetchJson("/api/nodes"),
+    fetchJson("/api/history").catch(()=>({samples:[]}))]);
   const states = summary.states || {};
   const total = Object.values(states).reduce((a,b)=>a+b,0);
   hist.running.push(states.RUNNING||0); hist.total.push(total);
   hist.load.push(stats.loadavg_1m||0);
   hist.mem.push(stats.mem_used_frac||0);
   for(const k in hist) if(hist[k].length>120) hist[k].shift();
+  // Server-side history ring: sparklines survive a page reload (the
+  // client-side hist above is only the fallback for old heads).
+  const hs = (history.samples||[]).slice(-120);
+  const tasksSeries = hs.length ? hs.map(s=>s.tasks_per_s) : hist.running;
+  const loadSeries  = hs.length ? hs.map(s=>s.load_1m) : hist.load;
+  const memSeries   = hs.length ? hs.map(s=>s.mem_used_frac) : hist.mem;
+  const tokSeries   = hs.map(s=>s.tokens_per_s);
+  const tokRow = tokSeries.some(v=>v>0) ?
+    `<h2>tokens/s</h2>${spark(tokSeries, 220, 44, "#2e9e62")}` : "";
   const flightRows = Object.entries(summary.flight||{}).flatMap(
     ([fn,d])=>Object.entries(d.stages).map(([stage,s])=>(
       {fn, stage, count:s.count, p50_ms:s.p50_ms, p99_ms:s.p99_ms})));
@@ -157,9 +184,10 @@ async function renderOverview(){
     .map(([k,v])=>`<div class="card"><div class="v">${esc(v)}</div>
       <div class="k">${esc(k)}</div></div>`).join("");
   return `<div class="cards">${cards}</div>
-    <h2>running tasks</h2>${spark(hist.running)}
-    <h2>host load (1m)</h2>${spark(hist.load, 220, 44, "#d4824a")}
-    <h2>memory used fraction</h2>${spark(hist.mem, 220, 44, "#7a4ad4")}
+    <h2>${hs.length ? "tasks/s" : "running tasks"}</h2>${spark(tasksSeries)}
+    ${tokRow}
+    <h2>host load (1m)</h2>${spark(loadSeries, 220, 44, "#d4824a")}
+    <h2>memory used fraction</h2>${spark(memSeries, 220, 44, "#7a4ad4")}
     <h2>task stage latency (flight recorder)</h2>${table(flightRows)}
     <h2>nodes</h2>${table(nodes)}`;
 }
@@ -235,6 +263,10 @@ class Dashboard:
             "/api/jobs": state_api.list_jobs,
             "/api/event_stats": state_api.event_loop_stats,
             "/api/serve": _serve_status,
+            "/api/traces": _trace_index,
+            # Server-side metrics history ring: sparklines survive a
+            # page reload, and `rt top` renders the same body.
+            "/api/history": telemetry.history,
         }
 
         class Handler(BaseHTTPRequestHandler):
@@ -271,6 +303,9 @@ class Dashboard:
                 # Drill-down routes: /api/task/<hex>, /api/logs/<worker>
                 # (reference: dashboard per-task pages + log proxying).
                 fn = routes.get(path)
+                if fn is None and path.startswith("/api/traces/"):
+                    trace_id = path[len("/api/traces/"):]
+                    fn = lambda: _trace_one(trace_id)  # noqa: E731
                 if fn is None and path.startswith("/api/task/"):
                     task_hex = path[len("/api/task/"):]
                     fn = lambda: state_api.task_detail(task_hex)  # noqa: E731
@@ -307,9 +342,31 @@ class Dashboard:
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True, name="rt-dashboard")
         self._thread.start()
+        # History sampler: one snapshot of the head registry into the
+        # bounded time-series ring per scrape interval. Owned by the
+        # dashboard (it is the head's long-lived observability process
+        # anchor); gauges refresh first so the sample sees live values.
+        self._sampler_stop = threading.Event()
+
+        def _sample_loop():
+            from ..core.config import config
+
+            period = max(0.1, config().metrics_report_interval_ms / 1e3)
+            while not self._sampler_stop.wait(period):
+                try:
+                    telemetry.refresh_cluster_gauges()
+                    telemetry.record_history_sample()
+                except Exception:  # noqa: BLE001 — sampler must survive
+                    pass
+
+        self._sampler = threading.Thread(target=_sample_loop, daemon=True,
+                                         name="rt-history-sampler")
+        self._sampler.start()
         return self
 
     def stop(self) -> None:
+        if getattr(self, "_sampler_stop", None) is not None:
+            self._sampler_stop.set()
         if self._server is not None:
             self._server.shutdown()
             self._server = None

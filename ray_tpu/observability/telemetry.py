@@ -243,6 +243,15 @@ def absorb(payload: dict) -> None:
                 if len(_remote_events) >= _REMOTE_EVENTS_MAX:
                     count_dropped("remote_events")
                 _remote_events.append(event)
+    if payload.get("spans"):
+        # Same absorb stream feeds the per-request trace store (outside
+        # _absorb_lock: the store has its own lock and the LRU/sampling
+        # work must not serialize the metric merge path).
+        from . import tracestore
+
+        tracestore.flush_local()  # interleave buffered head-local spans
+        for event in payload["spans"]:
+            tracestore.ingest_event(event)
     flight_events = payload.get("flight")
     if flight_events:
         from . import flight as flight_mod
@@ -273,6 +282,177 @@ def clear() -> None:
     with _absorb_lock:
         _remote_events.clear()
         _proc_names.clear()
+    clear_history()
+
+
+# -- metrics history ring (server-side sparklines / `rt top`) ---------------
+#
+# The head registry is a point-in-time surface: a dashboard reload (or a
+# freshly attached `rt top`) used to start its sparklines from nothing
+# because history lived client-side (dashboard.py JS). Here the head
+# snapshots the interesting rt_* series into a bounded time-series ring
+# every scrape interval; /api/history serves it and `rt top` renders it.
+
+_HISTORY_MAX = 720  # samples; 12 min at the default 1s interval
+_history: deque = deque(maxlen=_HISTORY_MAX)
+_history_lock = threading.Lock()
+_history_prev: Dict[str, Any] = {}
+
+
+def _sum_series(snap: Dict[str, tuple], name: str) -> float:
+    entry = snap.get(name)
+    if entry is None:
+        return 0.0
+    _kind, data = entry
+    try:
+        return float(sum(data.values()))
+    except TypeError:
+        return 0.0
+
+
+def _agg_hist(snap: Dict[str, tuple], name: str) -> Optional[dict]:
+    entry = snap.get(name)
+    if entry is None or entry[0] != "histogram":
+        return None
+    buckets: Optional[List[float]] = None
+    total_sum, total_count = 0.0, 0
+    for h in entry[1].values():
+        b = h.get("buckets") or []
+        if buckets is None:
+            buckets = [0.0] * len(b)
+        if len(b) == len(buckets):
+            for i, c in enumerate(b):
+                buckets[i] += c
+        total_sum += float(h.get("sum", 0.0))
+        total_count += int(h.get("count", 0))
+    if buckets is None:
+        return None
+    return {"buckets": buckets, "sum": total_sum, "count": total_count}
+
+
+def _hist_window_pct(name: str, agg: Optional[dict],
+                     prev: Optional[dict], q: float) -> float:
+    """Percentile estimate over the observations that arrived since the
+    previous sample (bucket deltas, linear interpolation within the
+    winning bucket; the +Inf bucket answers with its lower bound)."""
+    if agg is None:
+        return 0.0
+    metric = registry.get(name)
+    boundaries = list(metric.boundaries) if metric is not None else []
+    cur = agg["buckets"]
+    old = (prev or {}).get("buckets") or [0.0] * len(cur)
+    if len(old) != len(cur):
+        old = [0.0] * len(cur)
+    deltas = [max(0.0, a - b) for a, b in zip(cur, old)]
+    total = sum(deltas)
+    if total <= 0:
+        return -1.0  # nothing new this window; caller carries forward
+    target = q * total
+    seen = 0.0
+    for i, d in enumerate(deltas):
+        if seen + d >= target and d > 0:
+            lo = boundaries[i - 1] if i > 0 and i - 1 < len(boundaries) \
+                else 0.0
+            hi = boundaries[i] if i < len(boundaries) else lo
+            frac = (target - seen) / d
+            return lo + (hi - lo) * frac
+        seen += d
+    return boundaries[-1] if boundaries else 0.0
+
+
+def record_history_sample(now: Optional[float] = None) -> Optional[dict]:
+    """Snapshot one history sample from the head registry (plus host
+    load/mem). Called by the dashboard's sampler thread every scrape
+    interval; safe to call ad hoc (tests, `rt top --local`)."""
+    import time as _time
+
+    from ..core.config import config as _config
+
+    if not _config().telemetry_enabled:
+        return None
+    now = _time.time() if now is None else now
+    snap = registry.collect_all()
+    ttft = _agg_hist(snap, "rt_llm_ttft_seconds")
+    itl = _agg_hist(snap, "rt_llm_decode_per_token_seconds")
+    with _history_lock:
+        prev = dict(_history_prev)
+        dt = max(1e-6, now - prev["t"]) if prev else None
+
+        def rate(name: str, total: float) -> float:
+            if not prev or dt is None:
+                return 0.0
+            return max(0.0, total - prev.get(name, 0.0)) / dt
+
+        tasks_total = _sum_series(snap, "rt_tasks_finished")
+        tokens_total = _sum_series(snap, "rt_llm_tokens_generated_total")
+        last = _history[-1] if _history else {}
+
+        def pct(name: str, agg, prev_key: str, q: float,
+                carry_key: str) -> float:
+            v = _hist_window_pct(name, agg, prev.get(prev_key), q)
+            if v < 0:  # quiet window: carry the last estimate forward
+                return float(last.get(carry_key, 0.0))
+            return round(v * 1e3, 3)
+
+        sample = {
+            "ts": round(now, 3),
+            "tasks_per_s": round(rate("tasks_total", tasks_total), 3),
+            "tokens_per_s": round(rate("tokens_total", tokens_total), 3),
+            "queue_depth": _sum_series(snap, "rt_serve_queue_depth"),
+            "replicas": _sum_series(snap, "rt_serve_replicas"),
+            "workers": _sum_series(snap, "rt_workers_alive"),
+            "pages_used": _sum_series(snap, "rt_llm_pages_used"),
+            "pages_free": _sum_series(snap, "rt_llm_pages_free"),
+            "ttft_p50_ms": pct("rt_llm_ttft_seconds", ttft, "ttft",
+                               0.5, "ttft_p50_ms"),
+            "ttft_p99_ms": pct("rt_llm_ttft_seconds", ttft, "ttft",
+                               0.99, "ttft_p99_ms"),
+            "itl_p50_ms": pct("rt_llm_decode_per_token_seconds", itl,
+                              "itl", 0.5, "itl_p50_ms"),
+            "itl_p99_ms": pct("rt_llm_decode_per_token_seconds", itl,
+                              "itl", 0.99, "itl_p99_ms"),
+        }
+        try:
+            with open("/proc/loadavg") as f:
+                sample["load_1m"] = float(f.read().split()[0])
+        except Exception:  # noqa: BLE001 — non-Linux host
+            sample["load_1m"] = 0.0
+        try:
+            mem = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, _, v = line.partition(":")
+                    mem[k] = v.strip()
+            total_kb = int(mem["MemTotal"].split()[0])
+            avail_kb = int(mem["MemAvailable"].split()[0])
+            sample["mem_used_frac"] = round(1 - avail_kb / total_kb, 4)
+        except Exception:  # noqa: BLE001
+            sample["mem_used_frac"] = 0.0
+        _history.append(sample)
+        _history_prev.clear()
+        _history_prev.update({
+            "t": now, "tasks_total": tasks_total,
+            "tokens_total": tokens_total, "ttft": ttft, "itl": itl,
+        })
+    return sample
+
+
+def history(limit: int = _HISTORY_MAX) -> Dict[str, Any]:
+    """The ring, newest last — the ``/api/history`` body."""
+    from ..core.config import config as _config
+
+    with _history_lock:
+        samples = list(_history)[-limit:]
+    return {
+        "interval_ms": _config().metrics_report_interval_ms,
+        "samples": samples,
+    }
+
+
+def clear_history() -> None:
+    with _history_lock:
+        _history.clear()
+        _history_prev.clear()
 
 
 def refresh_cluster_gauges() -> None:

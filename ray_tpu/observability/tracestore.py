@@ -1,0 +1,369 @@
+"""Head-side trace store: per-request span index behind ``rt trace``.
+
+Reference analog: the OpenTelemetry collector's tail-sampling processor
+plus the trace page of any APM backend — the piece the reference leaves
+to an external OTLP endpoint. Here the head IS the backend: spans
+already flow to it over the PR-13 telemetry plane (worker/daemon
+payloads land in ``telemetry.absorb``) and, for head-local spans
+(proxy/router), through the tracer's ``on_record`` sink — this module
+indexes both streams by ``trace_id`` so one HTTP request's whole
+proxy → router → replica → engine tree is queryable by the id the proxy
+returned in ``x-request-id``.
+
+Policy, bounded like every other head aggregate:
+
+- **LRU store** of ``trace_store_max_traces`` distinct trace ids;
+  evictions are counted in
+  ``rt_telemetry_dropped_total{buffer="tracestore"}`` (warn-once).
+- **Head sampling**: ``trace_sample_rate`` decides per trace id
+  (deterministic hash, so every span of a request shares the verdict).
+- **Tail retention**: sampled-out traces sit in a small probation
+  buffer; a slow (``trace_slow_ms``) or errored span promotes the whole
+  trace into the store, so tail exemplars are never sampled away.
+- A replacement head after failover starts clean (:func:`clear` runs in
+  ``Runtime.__init__``, mirroring ``flight.clear()``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+# Spans kept per trace: a runaway span producer (a decode loop emitting
+# per-token spans, say) must not let one trace eat the store.
+_SPANS_PER_TRACE_MAX = 512
+# Sampled-out traces awaiting a tail-retention verdict. Small on
+# purpose: probation only needs to span one request's lifetime.
+_PROBATION_MAX = 256
+
+_lock = threading.Lock()
+# trace_id -> {"spans": [event], "t0": us, "t1": us, "reason": str}
+_traces: "OrderedDict[str, dict]" = OrderedDict()
+_probation: "OrderedDict[str, list]" = OrderedDict()
+_kept_counter = None
+_store_gauge = None
+_KEPT_KEYS = {r: (("reason", r),) for r in ("sampled", "tail")}
+
+
+def _cfg():
+    from ..core.config import config
+
+    return config()
+
+
+def _metrics():
+    global _kept_counter, _store_gauge
+    if _kept_counter is None:
+        from .metrics import Counter, Gauge, get_or_create
+
+        _kept_counter = get_or_create(
+            Counter, "rt_trace_store_kept_total",
+            "Traces admitted to the head trace store, by retention "
+            "reason (sampled = head sampling, tail = slow/errored "
+            "promotion)", ("reason",))
+        _store_gauge = get_or_create(
+            Gauge, "rt_trace_store_traces",
+            "Distinct traces resident in the head trace store")
+    return _kept_counter, _store_gauge
+
+
+def sampled(trace_id: str) -> bool:
+    """Deterministic head-sampling verdict for a trace id: every span
+    of the trace — whichever process shipped it — gets the same answer
+    without coordination."""
+    rate = float(_cfg().trace_sample_rate)
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    h = zlib.crc32(trace_id.encode("utf-8", "replace")) & 0xFFFFFFFF
+    return h / float(1 << 32) < rate
+
+
+def _is_tail_event(event: dict) -> bool:
+    """Slow or errored span => the trace is a tail exemplar."""
+    try:
+        if event.get("dur", 0.0) >= float(_cfg().trace_slow_ms) * 1e3:
+            return True
+    except (TypeError, ValueError):
+        pass
+    args = event.get("args")
+    return bool(isinstance(args, dict) and args.get("error"))
+
+
+def _append_locked(rec: dict, event: dict) -> None:
+    if len(rec["spans"]) >= _SPANS_PER_TRACE_MAX:
+        from . import telemetry
+
+        telemetry.count_dropped("tracestore_spans")
+        return
+    rec["spans"].append(event)
+    ts = float(event.get("ts", 0.0))
+    dur = float(event.get("dur", 0.0) or 0.0)
+    rec["t0"] = ts if rec["t0"] is None else min(rec["t0"], ts)
+    rec["t1"] = max(rec["t1"] or 0.0, ts + dur)
+
+
+def _admit_locked(trace_id: str, reason: str) -> dict:
+    rec = _traces.get(trace_id)
+    if rec is not None:
+        _traces.move_to_end(trace_id)
+        return rec
+    evicted = 0
+    maxn = int(_cfg().trace_store_max_traces)
+    while len(_traces) >= max(1, maxn):
+        _traces.popitem(last=False)
+        evicted += 1
+    rec = _traces[trace_id] = {"spans": [], "t0": None, "t1": None,
+                               "reason": reason}
+    kept, gauge = _metrics()
+    kept.inc_key(_KEPT_KEYS.get(reason, _KEPT_KEYS["sampled"]))
+    gauge.set(float(len(_traces)))
+    if evicted:
+        from . import telemetry
+
+        telemetry.count_dropped("tracestore", evicted)
+    return rec
+
+
+def ingest_event(event: dict) -> None:
+    """File one chrome-form span event (shipped or head-local) under its
+    trace id. Called from ``telemetry.absorb`` for remote payloads and
+    from :func:`ingest_local_span` for head-recorded spans."""
+    if not isinstance(event, dict):
+        return
+    args = event.get("args")
+    trace_id = args.get("trace_id") if isinstance(args, dict) else None
+    if not trace_id:
+        return
+    with _lock:
+        rec = _traces.get(trace_id)
+        if rec is not None:
+            _traces.move_to_end(trace_id)
+            _append_locked(rec, event)
+            return
+        if sampled(trace_id):
+            _append_locked(_admit_locked(trace_id, "sampled"), event)
+            return
+        # Sampled out: park on probation until a slow/errored span
+        # proves the trace is a tail exemplar worth keeping anyway.
+        pending = _probation.get(trace_id)
+        if pending is None:
+            while len(_probation) >= _PROBATION_MAX:
+                _probation.popitem(last=False)  # by-design discard
+            pending = _probation[trace_id] = []
+        else:
+            _probation.move_to_end(trace_id)
+        if len(pending) < _SPANS_PER_TRACE_MAX:
+            pending.append(event)
+        if _is_tail_event(event):
+            rec = _admit_locked(trace_id, "tail")
+            for ev in _probation.pop(trace_id, ()):
+                _append_locked(rec, ev)
+
+
+# Head-local spans park here until a query/absorb drains them: the
+# tracer's on_record hook fires on the task-submit hot path, and inline
+# indexing (chrome-event conversion + LRU admit + metrics) costs ~20us
+# per span — measured by the ISSUE 20 overhead A/B. deque append is
+# atomic, so the hot path pays one append and nothing else.
+_local_pending: deque = deque(maxlen=4096)
+
+
+def ingest_local_span(span) -> None:
+    """Tracer ``on_record`` sink (head process only): buffer the
+    finished Span; :func:`flush_local` indexes it on the next query or
+    telemetry absorb. Installed by ``Runtime.__init__`` on the head."""
+    if span.end_s is None:
+        return
+    if len(_local_pending) == _local_pending.maxlen:
+        from . import telemetry
+
+        telemetry.count_dropped("tracestore_pending")
+    _local_pending.append((span, os.getpid()))
+
+
+def flush_local() -> None:
+    """Drain buffered head-local spans into the trace index. Called
+    from every query entry point and from ``telemetry.absorb`` — off
+    the span producers' critical path."""
+    from .tracing import span_chrome_event
+
+    while True:
+        try:
+            s, pid = _local_pending.popleft()
+        except IndexError:
+            return
+        ingest_event(span_chrome_event(s, pid))
+
+
+def install_head_sink() -> None:
+    from .tracing import get_tracer
+
+    get_tracer().on_record = ingest_local_span
+
+
+def _proc_label(pid) -> str:
+    from . import telemetry
+
+    if pid == os.getpid():
+        return "driver"
+    name = telemetry._proc_names.get(pid)
+    return name if name else f"pid {pid}"
+
+
+def _normalize(event: dict) -> dict:
+    args = dict(event.get("args") or {})
+    return {
+        "name": event.get("name"),
+        "span_id": args.pop("span_id", None),
+        "parent_id": args.pop("parent_id", None),
+        "trace_id": args.pop("trace_id", None),
+        "start_us": float(event.get("ts", 0.0)),
+        "dur_ms": round(float(event.get("dur", 0.0) or 0.0) / 1e3, 3),
+        "pid": event.get("pid"),
+        "proc": _proc_label(event.get("pid")),
+        "attributes": args,
+    }
+
+
+def lookup(trace_id_or_prefix: str) -> Optional[str]:
+    """Resolve a (possibly truncated) trace id to a stored one."""
+    flush_local()
+    with _lock:
+        if trace_id_or_prefix in _traces:
+            return trace_id_or_prefix
+        matches = [t for t in _traces if t.startswith(trace_id_or_prefix)]
+    return matches[0] if len(matches) == 1 else None
+
+
+def get_trace(trace_id: str) -> Optional[Dict[str, Any]]:
+    """One trace: normalized spans (sorted by start), joined flight
+    records for any task ids its spans reference, and the process set —
+    the ``rt trace <id>`` / ``/api/traces/<id>`` body."""
+    resolved = lookup(trace_id)
+    if resolved is None:
+        return None
+    with _lock:
+        rec = _traces.get(resolved)
+        if rec is None:
+            return None
+        spans = [_normalize(e) for e in rec["spans"]]
+        t0, t1 = rec["t0"], rec["t1"]
+        reason = rec["reason"]
+    spans.sort(key=lambda s: s["start_us"])
+    task_ids = {s["attributes"].get("task_id") for s in spans
+                if s["attributes"].get("task_id")}
+    tasks: List[dict] = []
+    if task_ids:
+        from . import flight
+
+        tasks = [row for row in flight.recent_tasks(limit=500)
+                 if row.get("task_id") in task_ids]
+    return {
+        "trace_id": resolved,
+        "duration_ms": round(((t1 or 0.0) - (t0 or 0.0)) / 1e3, 3),
+        "retention": reason,
+        "procs": sorted({s["proc"] for s in spans}),
+        "spans": spans,
+        "tasks": tasks,
+    }
+
+
+def _root_name(spans: List[dict]) -> str:
+    ids = {s["span_id"] for s in spans}
+    for s in spans:
+        if s["parent_id"] is None or s["parent_id"] not in ids:
+            return s["name"] or "?"
+    return spans[0]["name"] if spans else "?"
+
+
+def list_traces(limit: int = 100) -> List[Dict[str, Any]]:
+    """Summaries of resident traces, most recently touched last."""
+    flush_local()
+    with _lock:
+        items = [(tid, [_normalize(e) for e in rec["spans"]],
+                  rec["t0"], rec["t1"], rec["reason"])
+                 for tid, rec in _traces.items()]
+    out = []
+    for tid, spans, t0, t1, reason in items[-limit:]:
+        spans.sort(key=lambda s: s["start_us"])
+        out.append({
+            "trace_id": tid,
+            "root": _root_name(spans),
+            "duration_ms": round(((t1 or 0.0) - (t0 or 0.0)) / 1e3, 3),
+            "spans": len(spans),
+            "procs": sorted({s["proc"] for s in spans}),
+            "retention": reason,
+            "error": any(s["attributes"].get("error") for s in spans),
+        })
+    return out
+
+
+def slow_traces(n: int = 10) -> List[Dict[str, Any]]:
+    """Tail exemplars: the n longest resident traces, slowest first."""
+    rows = list_traces(limit=int(_cfg().trace_store_max_traces))
+    rows.sort(key=lambda r: r["duration_ms"], reverse=True)
+    return rows[:n]
+
+
+def format_trace(data: Dict[str, Any]) -> str:
+    """Render :func:`get_trace` as an indented span tree with durations
+    and the owning process — the human side of ``rt trace <id>``."""
+    spans = data["spans"]
+    by_parent: Dict[Optional[str], List[dict]] = {}
+    ids = {s["span_id"] for s in spans}
+    for s in spans:
+        parent = s["parent_id"] if s["parent_id"] in ids else None
+        by_parent.setdefault(parent, []).append(s)
+    lines = [f"trace {data['trace_id']} — {data['duration_ms']:.3f}ms, "
+             f"{len(spans)} spans, {len(data['procs'])} proc(s) "
+             f"[{data['retention']}]"]
+
+    def walk(parent: Optional[str], depth: int) -> None:
+        for s in sorted(by_parent.get(parent, ()),
+                        key=lambda x: x["start_us"]):
+            attrs = {k: v for k, v in s["attributes"].items()
+                     if k not in ("trace_id",)}
+            extra = (" " + " ".join(f"{k}={v}" for k, v in
+                                    sorted(attrs.items()))) if attrs else ""
+            lines.append(f"{'  ' * (depth + 1)}{s['name']}  "
+                         f"{s['dur_ms']:.3f}ms  [{s['proc']}]{extra}")
+            walk(s["span_id"], depth + 1)
+
+    walk(None, 0)
+    for row in data.get("tasks", ()):
+        lines.append(
+            f"  task {row['task_id'][:12]} {row['name']}: "
+            f"queue {row['queue_s'] * 1e3:.3f}ms sched "
+            f"{row['sched_s'] * 1e3:.3f}ms exec "
+            f"{row['exec_s'] * 1e3:.3f}ms transfer "
+            f"{row['transfer_s'] * 1e3:.3f}ms")
+    return "\n".join(lines)
+
+
+def stats() -> Dict[str, int]:
+    flush_local()
+    with _lock:
+        return {"traces": len(_traces), "probation": len(_probation)}
+
+
+def clear() -> None:
+    """Drop every indexed trace (test isolation; and a replacement head
+    after failover must start clean, mirroring ``flight.clear()``)."""
+    _local_pending.clear()
+    with _lock:
+        _traces.clear()
+        _probation.clear()
+    if _store_gauge is not None:
+        _store_gauge.set(0.0)
+
+
+# Package-export spellings (match flight.py's convention: the short
+# names are too generic at the ``ray_tpu.observability`` level).
+trace_detail = get_trace
+trace_list = list_traces
+format_trace_tree = format_trace

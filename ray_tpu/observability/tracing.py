@@ -13,15 +13,24 @@ Enable with ``tracing.enable()`` (or config flag ``tracing_enabled``);
 
 from __future__ import annotations
 
-import contextlib
+import contextvars
 import functools
+import os
 import threading
 import time
-import uuid
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 _local = threading.local()
+
+# Async-safe request context: the serve replica's event loop interleaves
+# many requests on ONE thread, so the thread-local span stack cannot
+# carry a per-request trace context across awaits. A ContextVar is
+# task-local under asyncio — each request's handler task sees only its
+# own (trace_id, span_id).
+_request_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "rt_request_trace_ctx", default=None)
 
 
 @dataclass
@@ -47,14 +56,23 @@ class Tracer:
     def __init__(self, max_spans: int = 10_000):
         self.enabled = False
         self.max_spans = max_spans
-        self._spans: List[Span] = []
+        # deque(maxlen): a full ring drops the oldest span in O(1).
+        # The list version re-sliced 10k elements on EVERY record once
+        # full — ~15us/span of steady-state trim cost on the task hot
+        # path (caught by the ISSUE 20 overhead A/B).
+        self._spans: deque = deque(maxlen=max_spans)
         self._lock = threading.Lock()
         # Export plane (cluster telemetry): when a TelemetryExporter is
         # attached it flips export_enabled and drains finished spans on
         # each flush; bounded the same way so a stalled flusher can't
         # grow the process.
         self.export_enabled = False
-        self._export: List[Span] = []
+        self._export: deque = deque(maxlen=max_spans)
+        # Head-side sink: the trace store installs itself here so
+        # spans recorded IN the head process (proxy/router) reach the
+        # same per-trace index the telemetry plane feeds with shipped
+        # worker spans. Called outside the lock with the finished span.
+        self.on_record: Optional[Callable[[Span], None]] = None
 
     def enable(self) -> None:
         self.enabled = True
@@ -63,20 +81,35 @@ class Tracer:
         self.enabled = False
 
     def record(self, span: Span) -> None:
+        dropped = 0
         with self._lock:
+            if len(self._spans) == self.max_spans:
+                dropped += 1  # deque drops the oldest on append
             self._spans.append(span)
-            if len(self._spans) > self.max_spans:
-                self._spans = self._spans[-self.max_spans:]
             if self.export_enabled:
+                if len(self._export) == self.max_spans:
+                    dropped += 1
                 self._export.append(span)
-                if len(self._export) > self.max_spans:
-                    self._export = self._export[-self.max_spans:]
+        if dropped:
+            # The ring used to trim SILENTLY — a truncated trace looked
+            # identical to a quiet process. Counted + warn-once, same
+            # policy as every other bounded telemetry buffer.
+            from . import telemetry
+
+            telemetry.count_dropped("tracer", dropped)
+        hook = self.on_record
+        if hook is not None:
+            try:
+                hook(span)
+            except Exception:  # noqa: BLE001 — sink must not break apps
+                pass
 
     def drain_export(self) -> List[Span]:
         """Finished spans recorded since the last drain (telemetry
         flush path; worker/daemon processes ship these to the head)."""
         with self._lock:
-            out, self._export = self._export, []
+            out = list(self._export)
+            self._export.clear()
         return out
 
     def spans(self, name_prefix: str = "") -> List[Span]:
@@ -85,8 +118,8 @@ class Tracer:
 
     def clear(self) -> None:
         with self._lock:
-            self._spans = []
-            self._export = []  # cleared means cleared: nothing ships
+            self._spans.clear()
+            self._export.clear()  # cleared means cleared: nothing ships
 
     def chrome_trace_events(self) -> List[dict]:
         """Spans as chrome://tracing 'X' (complete) events, mergeable
@@ -111,8 +144,11 @@ def span_chrome_event(s: Span, pid) -> dict:
         "ts": s.start_s * 1e6,
         "dur": ((s.end_s or s.start_s) - s.start_s) * 1e6,
         "pid": pid, "tid": s.trace_id[:8],
+        # Full trace id travels in args (the tid row label is truncated
+        # for chrome://tracing readability): the head trace store keys
+        # its per-request index on it.
         "args": {**s.attributes, "span_id": s.span_id,
-                 "parent_id": s.parent_id},
+                 "parent_id": s.parent_id, "trace_id": s.trace_id},
     }
 
 
@@ -136,33 +172,75 @@ def current_span() -> Optional[Span]:
     return stack[-1] if stack else None
 
 
-@contextlib.contextmanager
-def span(name: str, **attributes) -> Iterator[Optional[Span]]:
+class _NullSpanCtx:
+    """Shared no-op CM for the tracing-disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpanCtx()
+
+
+class _SpanCtx:
+    """Hand-rolled context manager (the @contextmanager generator form
+    costs ~3us/span of frame churn — this sits on the task hot path)."""
+
+    __slots__ = ("_name", "_attributes", "_span")
+
+    def __init__(self, name: str, attributes: Dict[str, Any]):
+        self._name = name
+        self._attributes = attributes
+        self._span: Optional[Span] = None
+
+    def __enter__(self) -> Span:
+        # Parent resolution happens HERE, not in __init__: callers
+        # build the span CM before entering remote_context (see
+        # worker_main's `with trace_cm, span_cm:`), so resolving
+        # eagerly would miss the adopted context.
+        parent = current_span()
+        # Same fallback chain as inject_context: thread-local remote
+        # ctx (worker executing a task), then the asyncio request ctx
+        # (serve replica handler) — so a span opened inside an async
+        # handler joins the request's trace instead of minting a fresh
+        # id.
+        remote_ctx = (getattr(_local, "remote_context", None)
+                      or _request_ctx.get())
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif remote_ctx is not None:
+            trace_id, parent_id = remote_ctx
+        else:
+            trace_id, parent_id = os.urandom(16).hex(), None
+        s = self._span = Span(
+            name=self._name, span_id=os.urandom(8).hex(),
+            parent_id=parent_id, trace_id=trace_id, start_s=time.time(),
+            attributes=self._attributes)
+        stack = getattr(_local, "stack", None)
+        if stack is None:
+            stack = _local.stack = []
+        stack.append(s)
+        return s
+
+    def __exit__(self, *exc):
+        s = self._span
+        s.end_s = time.time()
+        _local.stack.pop()
+        _tracer.record(s)
+        return False
+
+
+def span(name: str, **attributes):
     """Context-managed span; nests under the thread's current span and
     continues a propagated remote context when present."""
     if not _tracer.enabled:
-        yield None
-        return
-    parent = current_span()
-    remote_ctx = getattr(_local, "remote_context", None)
-    if parent is not None:
-        trace_id, parent_id = parent.trace_id, parent.span_id
-    elif remote_ctx is not None:
-        trace_id, parent_id = remote_ctx
-    else:
-        trace_id, parent_id = uuid.uuid4().hex, None
-    s = Span(name=name, span_id=uuid.uuid4().hex[:16], parent_id=parent_id,
-             trace_id=trace_id, start_s=time.time(), attributes=attributes)
-    stack = getattr(_local, "stack", None)
-    if stack is None:
-        stack = _local.stack = []
-    stack.append(s)
-    try:
-        yield s
-    finally:
-        s.end_s = time.time()
-        stack.pop()
-        _tracer.record(s)
+        return _NULL_SPAN
+    return _SpanCtx(name, attributes)
 
 
 def trace_span(name: Optional[str] = None, **attributes):
@@ -184,24 +262,94 @@ def trace_span(name: Optional[str] = None, **attributes):
 # -- remote propagation (reference: trace context in TaskSpec) --------------
 
 def inject_context() -> Optional[tuple]:
-    """Capture (trace_id, span_id) to ship inside a TaskSpec."""
+    """Capture (trace_id, span_id) to ship inside a TaskSpec.
+
+    Resolution order mirrors :func:`span`: the thread's current span,
+    then a remote context adopted from a submitted task, then the
+    async request context set by the serve replica — so a nested
+    ``.remote()`` inside an async handler still joins the request's
+    trace even though no thread-local span is open across the await."""
     if not _tracer.enabled:
         return None
     s = current_span()
-    if s is None:
+    if s is not None:
+        return (s.trace_id, s.span_id)
+    remote_ctx = getattr(_local, "remote_context", None)
+    if remote_ctx is not None:
+        return tuple(remote_ctx)
+    req_ctx = _request_ctx.get()
+    return tuple(req_ctx) if req_ctx is not None else None
+
+
+class _RemoteCtx:
+    """Class CM (not @contextmanager) — wraps every task execution."""
+
+    __slots__ = ("_ctx",)
+
+    def __init__(self, ctx: Optional[tuple]):
+        self._ctx = ctx
+
+    def __enter__(self):
+        if self._ctx is not None:
+            _local.remote_context = tuple(self._ctx)
         return None
-    return (s.trace_id, s.span_id)
+
+    def __exit__(self, *exc):
+        if self._ctx is not None:
+            _local.remote_context = None
+        return False
 
 
-@contextlib.contextmanager
-def remote_context(ctx: Optional[tuple]) -> Iterator[None]:
+def remote_context(ctx: Optional[tuple]) -> "_RemoteCtx":
     """Worker-side: adopt the submitted task's trace context so execution
     spans join the submitter's trace."""
+    return _RemoteCtx(ctx)
+
+
+def set_request_context(ctx: Optional[tuple]):
+    """Bind a request's (trace_id, span_id) to the CURRENT asyncio task
+    (or thread, outside a loop). Returns a token for
+    :func:`reset_request_context`. No-op (returns None) without a ctx."""
     if ctx is None:
-        yield
-        return
-    _local.remote_context = tuple(ctx)
-    try:
-        yield
-    finally:
-        _local.remote_context = None
+        return None
+    return _request_ctx.set(tuple(ctx))
+
+
+def reset_request_context(token) -> None:
+    if token is not None:
+        _request_ctx.reset(token)
+
+
+def get_request_context() -> Optional[tuple]:
+    """The (trace_id, span_id) bound to this task/thread, if any."""
+    return _request_ctx.get()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def record_span(name: str, trace_id: str,
+                parent_id: Optional[str] = None,
+                start_s: Optional[float] = None,
+                end_s: Optional[float] = None,
+                span_id: Optional[str] = None,
+                **attributes) -> Optional[Span]:
+    """Record a finished span with EXPLICIT identity and timestamps.
+
+    The context-managed :func:`span` can't express two shapes this PR
+    needs: spans synthesized after the fact from stage stamps (the LLM
+    engine's timing breakdown) and spans whose lifetime crosses awaits
+    on a shared event-loop thread (the proxy's root request span, the
+    router's assign). Both know their trace id and wall-clock bounds up
+    front; this records them without touching the thread-local stack."""
+    if not _tracer.enabled:
+        return None
+    now = time.time()
+    s = Span(name=name, span_id=span_id or new_span_id(),
+             parent_id=parent_id, trace_id=trace_id,
+             start_s=now if start_s is None else start_s,
+             end_s=now if end_s is None else end_s,
+             attributes=attributes)
+    _tracer.record(s)
+    return s

@@ -174,16 +174,143 @@ def cmd_timeline(args) -> int:
 
 
 def cmd_metrics(args) -> int:
-    """Cluster /metrics in Prometheus text form, straight from the head
-    registry (workers/daemons fold in via the telemetry plane)."""
+    """Cluster metrics from the head registry (workers/daemons fold in
+    via the telemetry plane). Default output is Prometheus text;
+    ``--json`` emits {name: {kind, series}} and an optional name prefix
+    narrows either form (``rt metrics rt_llm_ --json``) so scripts stop
+    regex-scraping the text exposition."""
     import ray_tpu as rt
     from ray_tpu.observability import refresh_cluster_gauges
     from ray_tpu.observability.metrics import registry
 
     rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
     refresh_cluster_gauges()
-    sys.stdout.write(registry.prometheus_text())
+    prefix = args.prefix or ""
+    if args.json:
+        out = {}
+        for name, (kind, data) in sorted(registry.collect_all().items()):
+            if not name.startswith(prefix):
+                continue
+            out[name] = {
+                "kind": kind,
+                "series": [{"tags": dict(tags_key), "value": value}
+                           for tags_key, value in data.items()],
+            }
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+    text = registry.prometheus_text()
+    if prefix:
+        keep = []
+        for line in text.splitlines():
+            # HELP/TYPE lines carry the metric name as the second
+            # token; sample lines start with it. Filter on either.
+            parts = line.split()
+            token = (parts[2] if line.startswith("#") and len(parts) > 2
+                     else line.partition("{")[0].partition(" ")[0])
+            if token.startswith(prefix):
+                keep.append(line)
+        text = "\n".join(keep) + ("\n" if keep else "")
+    sys.stdout.write(text)
     return 0
+
+
+def cmd_trace(args) -> int:
+    """``rt trace <id>``: one request's span tree (proxy -> router ->
+    replica -> engine) from the head trace store; ``--slow N`` lists the
+    longest resident traces; no args lists recent traces."""
+    import ray_tpu as rt
+    from ray_tpu.observability import tracestore
+
+    rt.init(ignore_reinit_error=True, num_cpus=args.num_cpus)
+    if args.trace_id:
+        data = tracestore.get_trace(args.trace_id)
+        if data is None:
+            print(f"no trace {args.trace_id!r} in the store "
+                  "(evicted, sampled out, or never seen)",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(data, indent=2, default=str))
+        else:
+            print(tracestore.format_trace(data))
+        return 0
+    rows = (tracestore.slow_traces(args.slow) if args.slow
+            else tracestore.list_traces(limit=args.limit))
+    if args.json:
+        print(json.dumps(rows, indent=2, default=str))
+        return 0
+    if not rows:
+        print("trace store is empty (tracing off, or no traffic yet)")
+        return 0
+    for r in rows:
+        err = " ERROR" if r["error"] else ""
+        print(f"{r['trace_id']}  {r['duration_ms']:>10.3f}ms  "
+              f"{r['spans']:>3} spans  {len(r['procs'])} proc(s)  "
+              f"[{r['retention']}]  {r['root']}{err}")
+    return 0
+
+
+def _render_top(hist: dict) -> str:
+    """One refresh frame of ``rt top`` from an /api/history body."""
+    samples = hist.get("samples") or []
+    if not samples:
+        return "no history yet (head just started?)"
+    cur = samples[-1]
+
+    def spark(key: str, n: int = 30) -> str:
+        marks = "▁▂▃▄▅▆▇█"
+        vals = [float(s.get(key, 0.0)) for s in samples[-n:]]
+        hi = max(vals) or 1.0
+        return "".join(marks[min(int(v / hi * (len(marks) - 1)),
+                                 len(marks) - 1)] for v in vals)
+
+    lines = [
+        "rt top — head metrics history "
+        f"(interval {hist.get('interval_ms', '?')}ms, "
+        f"{len(samples)} samples)",
+        "",
+        f"tasks/s   {cur['tasks_per_s']:>10.1f}  {spark('tasks_per_s')}",
+        f"tok/s     {cur['tokens_per_s']:>10.1f}  "
+        f"{spark('tokens_per_s')}",
+        f"queue     {cur['queue_depth']:>10.0f}  {spark('queue_depth')}",
+        f"replicas  {cur['replicas']:>10.0f}  workers "
+        f"{cur['workers']:.0f}",
+        f"pages     {cur['pages_used']:>10.0f} used / "
+        f"{cur['pages_free']:.0f} free  {spark('pages_used')}",
+        f"TTFT ms   p50 {cur['ttft_p50_ms']:>8.2f}  "
+        f"p99 {cur['ttft_p99_ms']:>8.2f}  {spark('ttft_p99_ms')}",
+        f"ITL ms    p50 {cur['itl_p50_ms']:>8.2f}  "
+        f"p99 {cur['itl_p99_ms']:>8.2f}  {spark('itl_p99_ms')}",
+        f"host      load {cur['load_1m']:.2f}  "
+        f"mem {cur['mem_used_frac'] * 100:.1f}%",
+    ]
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """``rt top``: live terminal view of the head's metrics history ring
+    (tasks/s, tok/s, queue depth, TTFT/ITL percentiles, KV pages) —
+    fetched from the dashboard's /api/history endpoint so it attaches to
+    a RUNNING head instead of booting its own runtime."""
+    import time
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/api/history"
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                hist = json.loads(resp.read().decode())
+        except Exception as e:  # noqa: BLE001 — head down/yet to start
+            print(f"rt top: cannot reach {url}: {e}", file=sys.stderr)
+            return 1
+        frame = _render_top(hist)
+        if args.once:
+            print(frame)
+            return 0
+        # ANSI home+clear keeps the view in place like top(1).
+        sys.stdout.write("\x1b[H\x1b[2J" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
 
 
 def cmd_microbenchmark(args) -> int:
@@ -369,7 +496,33 @@ def build_parser() -> argparse.ArgumentParser:
     lgp.add_argument("-n", "--lines", type=int, default=100,
                      help="tail this many lines per stream first")
     sub.add_parser("memory", help="object store usage")
-    sub.add_parser("metrics", help="cluster metrics (Prometheus text)")
+    mp = sub.add_parser("metrics", help="cluster metrics (Prometheus "
+                                        "text, or --json)")
+    mp.add_argument("prefix", nargs="?", default="",
+                    help="optional metric-name prefix filter, e.g. "
+                         "rt_llm_")
+    mp.add_argument("--json", action="store_true",
+                    help="structured {name: {kind, series}} instead of "
+                         "Prometheus text")
+    trp = sub.add_parser("trace", help="per-request span tree from the "
+                                       "head trace store")
+    trp.add_argument("trace_id", nargs="?", default="",
+                     help="trace id (= the response's x-request-id); a "
+                          "unique prefix works; omit to list traces")
+    trp.add_argument("--slow", type=int, default=0, metavar="N",
+                     help="list the N longest resident traces instead")
+    trp.add_argument("--limit", type=int, default=20,
+                     help="listing mode: show this many recent traces")
+    trp.add_argument("--json", action="store_true",
+                     help="machine-readable output")
+    top = sub.add_parser("top", help="live head metrics view (history "
+                                     "ring via the dashboard)")
+    top.add_argument("--url", default="http://127.0.0.1:8265",
+                     help="dashboard base URL")
+    top.add_argument("--interval", type=float, default=1.0,
+                     help="refresh period seconds")
+    top.add_argument("--once", action="store_true",
+                     help="print one frame and exit (scripts/tests)")
     tp = sub.add_parser("timeline", help="dump merged chrome://tracing json "
                                          "(driver + worker + daemon rows)")
     tp.add_argument("--output", default="/tmp/rt_timeline.json")
@@ -413,6 +566,8 @@ def main(argv=None) -> int:
         "logs": cmd_logs,
         "memory": cmd_memory,
         "metrics": cmd_metrics,
+        "trace": cmd_trace,
+        "top": cmd_top,
         "timeline": cmd_timeline,
         "microbenchmark": cmd_microbenchmark,
         "dashboard": cmd_dashboard,

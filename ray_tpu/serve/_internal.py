@@ -32,6 +32,7 @@ from ..core.exceptions import (
     OverloadedError,
     WorkerCrashedError,
 )
+from ..observability import tracing
 
 # -- first-class Serve metrics (reference: serve/_private/metrics_utils +
 # the serve_* series of metric_defs.cc). Created lazily in whichever
@@ -410,7 +411,8 @@ class _Replica:
                 pass
 
     async def handle_request(self, args, kwargs,
-                             timeout_s: Optional[float] = None):
+                             timeout_s: Optional[float] = None,
+                             trace_ctx: Optional[tuple] = None):
         # Sweep abandoned streams from the request path too: a replica
         # whose LAST streaming consumer disconnected would otherwise
         # leak that generator until another streaming request arrives.
@@ -419,6 +421,12 @@ class _Replica:
         self._ongoing += 1
         self._total += 1
         start = time.perf_counter()
+        # ContextVar, not the thread-local span stack: this coroutine
+        # interleaves with other requests on the replica's one event
+        # loop, and the binding must follow THIS request across awaits
+        # (nested .remote() calls and the LLM engine read it back).
+        token = tracing.set_request_context(trace_ctx)
+        t0 = time.time()
         ok = True
         try:
             fn = self.callable
@@ -429,6 +437,13 @@ class _Replica:
             ok = False
             raise
         finally:
+            if trace_ctx is not None:
+                tracing.record_span(
+                    "replica.handle", trace_id=trace_ctx[0],
+                    parent_id=trace_ctx[1], start_s=t0,
+                    deployment=self._deployment,
+                    **({} if ok else {"error": "handler raised"}))
+            tracing.reset_request_context(token)
             self._observe(start, 1, ok)
             self._ongoing -= 1
 
@@ -452,6 +467,11 @@ class _Replica:
 
         if self._streams:
             self._sweep_streams()
+        # Items are (args, kwargs) or (args, kwargs, trace_ctx) — the
+        # proxy ships per-request trace ctx as a third element; older
+        # callers (tests, handle fan-out) still send pairs.
+        items = [(it[0], it[1], it[2] if len(it) > 2 else None)
+                 for it in items]
         self._ongoing += len(items)
         self._total += len(items)
         start = time.perf_counter()
@@ -461,27 +481,52 @@ class _Replica:
             fn = self.callable
             if callable(fn) and inspect.iscoroutinefunction(
                     self._resolve_target(fn)):
-                async def one(args, kwargs):
+                async def one(args, kwargs, ctx):
+                    # gather() wraps each coroutine in its own task with
+                    # a COPY of the current context, so this binding is
+                    # per-item even though all items share the loop.
+                    token = tracing.set_request_context(ctx)
+                    t0 = time.time()
+                    err = None
                     try:
                         return ("ok", await self._invoke(fn, args,
                                                          kwargs,
                                                          timeout_s))
                     except Exception as e:  # noqa: BLE001 — isolation
+                        err = type(e).__name__
                         return ("err", _err_payload(e))
+                    finally:
+                        if ctx is not None:
+                            attrs = {"deployment": self._deployment}
+                            if err:
+                                attrs["error"] = err
+                            tracing.record_span(
+                                "replica.handle", trace_id=ctx[0],
+                                parent_id=ctx[1], start_s=t0, **attrs)
+                        tracing.reset_request_context(token)
 
                 out = list(await asyncio.gather(
-                    *(one(a, k) for a, k in items)))
+                    *(one(a, k, c) for a, k, c in items)))
                 return out
 
             def run_all():
                 out = []
-                for a, k in items:
+                for a, k, ctx in items:
+                    t0 = time.time()
                     try:
                         if not callable(fn):
                             raise TypeError("deployment is not callable")
-                        out.append(("ok", fn(*a, **k)))
+                        # Sync handlers run on ONE executor thread, so
+                        # the thread-local remote context is safe here.
+                        with tracing.remote_context(ctx):
+                            out.append(("ok", fn(*a, **k)))
                     except Exception as e:  # noqa: BLE001 — isolation
                         out.append(("err", _err_payload(e)))
+                    if ctx is not None:
+                        tracing.record_span(
+                            "replica.handle", trace_id=ctx[0],
+                            parent_id=ctx[1], start_s=t0,
+                            deployment=self._deployment)
                 return out
 
             loop = asyncio.get_running_loop()
@@ -514,10 +559,12 @@ class _Replica:
             self._ongoing -= len(items)
 
     async def call_method(self, method, args, kwargs,
-                          timeout_s: Optional[float] = None):
+                          timeout_s: Optional[float] = None,
+                          trace_ctx: Optional[tuple] = None):
         self._ongoing += 1
         self._total += 1
         start = time.perf_counter()
+        token = tracing.set_request_context(trace_ctx)
         ok = True
         try:
             return await self._invoke(
@@ -526,6 +573,7 @@ class _Replica:
             ok = False
             raise
         finally:
+            tracing.reset_request_context(token)
             self._observe(start, 1, ok)
             self._ongoing -= 1
 
@@ -1347,15 +1395,23 @@ class Router:
             f"available{detail}")
 
     def _submit(self, replica, key, method, args, kwargs,
-                deadline: Optional[float] = None):
+                deadline: Optional[float] = None,
+                ctx: Optional[tuple] = None):
         timeout_s = self._timeout_for(deadline)
         try:
-            if method:
-                ref = replica.call_method.remote(method, args, kwargs,
-                                                 timeout_s)
-            else:
-                ref = replica.handle_request.remote(args, kwargs,
-                                                    timeout_s)
+            # remote_context: the actor-submit span this .remote() opens
+            # (actor.py) adopts the REQUEST's trace, not a fresh one —
+            # the router runs on the proxy loop / executor threads where
+            # no thread-local span is open. The ctx also rides as an
+            # explicit arg so the replica can stamp its handler span and
+            # bind the asyncio request context.
+            with tracing.remote_context(ctx):
+                if method:
+                    ref = replica.call_method.remote(
+                        method, args, kwargs, timeout_s, ctx)
+                else:
+                    ref = replica.handle_request.remote(
+                        args, kwargs, timeout_s, ctx)
         except Exception:
             self._release(key)
             raise
@@ -1661,13 +1717,15 @@ class Router:
                 _pending_note(self._name, -1)
 
     def submit_on(self, replica, key, method, args, kwargs,
-                  deadline: Optional[float] = None):
+                  deadline: Optional[float] = None,
+                  ctx: Optional[tuple] = None):
         """Two-phase session assign, step 2: submit on the slot taken
         by acquire_session_slot. Rides _submit, so the safe-retry
         interceptor still re-dispatches if the pinned replica dies
         before any response byte (re-prefill recovery makes the retried
         request bit-for-bit correct on the survivor)."""
-        return self._submit(replica, key, method, args, kwargs, deadline)
+        return self._submit(replica, key, method, args, kwargs, deadline,
+                            ctx)
 
     def release_slot(self, key: bytes) -> None:
         """Give back a slot reserved by acquire_session_slot that was

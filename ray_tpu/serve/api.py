@@ -15,8 +15,11 @@ import json
 import sys
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
+
+from ..observability import tracing
 
 from ..core import get, get_actor, kill, remote
 from ..core.exceptions import (
@@ -614,8 +617,19 @@ class _AsyncHTTPProxy:
         await asyncio.wait_for(fut, timeout)
         return get(ref, timeout=5)
 
+    @staticmethod
+    def _request_id(headers: Optional[dict]) -> str:
+        """The client's x-request-id when it is a sane header token
+        (bounded length, url/log-safe charset); a fresh uuid otherwise."""
+        rid = (headers or {}).get("x-request-id", "")
+        if rid and len(rid) <= 128 and all(
+                c.isalnum() or c in "._-" for c in rid):
+            return rid
+        return uuid.uuid4().hex
+
     async def _submit_coalesced(self, name: str, handle, args,
-                                deadline: Optional[float] = None):
+                                deadline: Optional[float] = None,
+                                ctx: Optional[tuple] = None):
         """Queue one request on the deployment's coalescer and await its
         result. A drainer task per deployment pops whatever is pending
         (up to 16) into ONE replica RPC; batches form naturally from
@@ -637,14 +651,15 @@ class _AsyncHTTPProxy:
                 f"deployment {name!r} overloaded: proxy queue is full "
                 f"(max_pending={mp})")
         fut = self._loop.create_future()
-        q.append((args, fut, deadline))
+        q.append((args, fut, deadline, ctx))
         if name not in self._draining:
             self._draining.add(name)
             asyncio.ensure_future(self._drain_pending(name, handle))
         return await fut
 
     async def _submit_session(self, name: str, handle, args, sid: str,
-                              deadline: Optional[float] = None):
+                              deadline: Optional[float] = None,
+                              ctx: Optional[tuple] = None):
         """Sticky-session submit path (x-serve-session): bypasses the
         coalescer — the slot is reserved on the session's PINNED
         replica first (two-phase), and when that pin had to move
@@ -674,8 +689,13 @@ class _AsyncHTTPProxy:
                     # — the engine simply prefills cold.
                     pass
         # submit_on's _submit gives the slot back itself on a raise.
+        assign_t0 = time.time()
         ref, _ = router.submit_on(replica, key, None, args, {},
-                                  eff_deadline)
+                                  eff_deadline, ctx)
+        if ctx is not None:
+            tracing.record_span("router.assign", trace_id=ctx[0],
+                                parent_id=ctx[1], start_s=assign_t0,
+                                deployment=name, session=sid)
         timeout = 60.0
         if eff_deadline is not None:
             timeout = max(0.0, eff_deadline - time.monotonic()) + 2.0
@@ -706,12 +726,16 @@ class _AsyncHTTPProxy:
                 batch = []
                 while q and len(batch) < 16:
                     batch.append(q.popleft())
-                items = [(args, {}) for args, _, _ in batch]
+                # 3-tuple items: the per-request trace ctx rides the
+                # batch into the replica so handler-side spans (and any
+                # nested .remote() the handler makes) join the trace.
+                items = [(args, {}, ctx) for args, _, _, ctx in batch]
                 # Tightest member deadline bounds the whole coalesced
                 # RPC (deadlines within one deployment's batch are near-
                 # uniform: all derive from the same request_deadline_s).
-                dls = [d for _, _, d in batch if d is not None]
+                dls = [d for _, _, d, _ in batch if d is not None]
                 deadline = min(dls) if dls else None
+                assign_t0 = time.time()
                 try:
                     assigned = handle._router.try_assign_batch(
                         items, deadline)
@@ -723,7 +747,7 @@ class _AsyncHTTPProxy:
                 except Exception as e:  # noqa: BLE001 — a dead replica
                     # must 500 the batch, never strand its futures (the
                     # drainer survives to serve later arrivals).
-                    for _, fut, _ in batch:
+                    for _, fut, _, _ in batch:
                         if not fut.done():
                             fut.set_exception(e)
                     continue
@@ -732,6 +756,12 @@ class _AsyncHTTPProxy:
                     for entry in reversed(batch[n:]):
                         q.appendleft(entry)
                     batch = batch[:n]
+                for _, _, _, ctx in batch:
+                    if ctx is not None:
+                        tracing.record_span(
+                            "router.assign", trace_id=ctx[0],
+                            parent_id=ctx[1], start_s=assign_t0,
+                            deployment=name, batch=len(batch))
                 # distribute concurrently; keep draining new arrivals
                 asyncio.ensure_future(
                     self._distribute(ref, replica, batch, deadline))
@@ -755,16 +785,16 @@ class _AsyncHTTPProxy:
             err: Exception = (DeadlineExceededError(
                 "request exceeded its deadline awaiting the replica")
                 if deadline is not None else e)
-            for _, fut, _ in batch:
+            for _, fut, _, _ in batch:
                 if not fut.done():
                     fut.set_exception(err)
             return
         except Exception as e:  # noqa: BLE001 — replica died mid-batch
-            for _, fut, _ in batch:
+            for _, fut, _, _ in batch:
                 if not fut.done():
                     fut.set_exception(e)
             return
-        for (_, fut, _), res in zip(batch, results):
+        for (_, fut, _, _), res in zip(batch, results):
             if fut.done():
                 continue
             if res[0] == "err":
@@ -816,13 +846,15 @@ class _AsyncHTTPProxy:
                 pass
 
     def _write_simple(self, writer, status: int, payload: bytes,
-                      keep: bool) -> None:
+                      keep: bool, rid: Optional[str] = None) -> None:
         conn = b"keep-alive" if keep else b"close"
+        rid_hdr = (b"x-request-id: %s\r\n" % rid.encode("ascii")
+                   if rid else b"")
         writer.write(
             b"HTTP/1.1 %d %s\r\nContent-Type: application/json\r\n"
-            b"Content-Length: %d\r\nConnection: %s\r\n\r\n%s"
+            b"Content-Length: %d\r\n%sConnection: %s\r\n\r\n%s"
             % (status, b"OK" if status == 200 else b"ERR",
-               len(payload), conn, payload))
+               len(payload), rid_hdr, conn, payload))
 
     def _resolve_route(self, path: str) -> Optional[str]:
         """Longest-prefix match of the request path against registered
@@ -859,7 +891,32 @@ class _AsyncHTTPProxy:
                 deadline = time.monotonic() + max(float(hdr), 0.0)
             except ValueError:
                 pass
+        # Request identity: honor a sane client-sent x-request-id (so
+        # callers can pre-correlate their own logs) or mint one. It is
+        # echoed on EVERY response, stamped into 5xx bodies, and doubles
+        # as the trace id — `rt trace <x-request-id>` answers "where did
+        # THIS request spend its time".
+        rid = self._request_id(headers)
+        t0 = time.time()
+        root_span = (tracing.new_span_id()
+                     if tracing.get_tracer().enabled else None)
+        ctx = (rid, root_span) if root_span is not None else None
         name = None
+
+        def _finish(status: int, error: Optional[str] = None) -> None:
+            # Root span, recorded with explicit bounds: the proxy's
+            # event loop interleaves requests on one thread, so a
+            # context-managed span could not stay open across awaits.
+            if root_span is None:
+                return
+            attrs: Dict[str, Any] = {"path": path, "status": status}
+            if name:
+                attrs["deployment"] = name
+            if error:
+                attrs["error"] = str(error)[:200]
+            tracing.record_span("proxy.request", trace_id=rid,
+                                span_id=root_span, start_s=t0, **attrs)
+
         try:
             import time as _time
 
@@ -887,8 +944,10 @@ class _AsyncHTTPProxy:
                 self._write_simple(
                     writer, 404,
                     json.dumps(
-                        {"error": f"no route matches {path}"}
-                    ).encode(), keep)
+                        {"error": f"no route matches {path}",
+                         "request_id": rid}
+                    ).encode(), keep, rid)
+                _finish(404)
                 return True
             handle = self._handles.get(name)
             if handle is None:
@@ -903,12 +962,12 @@ class _AsyncHTTPProxy:
                     payload.setdefault("session", sid)
                 args = () if payload is None else (payload,)
                 result, replica = await self._submit_session(
-                    name, handle, args, sid, deadline)
+                    name, handle, args, sid, deadline, ctx)
                 self._note_session(name, sid, payload, result)
             else:
                 args = () if payload is None else (payload,)
                 result, replica = await self._submit_coalesced(
-                    name, handle, args, deadline)
+                    name, handle, args, deadline, ctx)
         except Exception as e:  # noqa: BLE001
             # No cache surgery here: an application-level 500 says
             # nothing about routes, and the TTL already bounds how long
@@ -936,7 +995,9 @@ class _AsyncHTTPProxy:
                 if m is not None:
                     m["deadline_exceeded"].inc(1.0)
             try:
-                body = {"error": str(e)}
+                # request_id in the error body: a 503/504 log line is
+                # exactly the request you want to `rt trace` afterwards.
+                body = {"error": str(e), "request_id": rid}
                 status = 500
                 if overloaded:
                     body["overloaded"] = True
@@ -945,27 +1006,37 @@ class _AsyncHTTPProxy:
                     body["deadline_exceeded"] = True
                     status = 504
                 self._write_simple(
-                    writer, status, json.dumps(body).encode(), keep)
+                    writer, status, json.dumps(body).encode(), keep, rid)
             except Exception:
+                _finish(500, str(e))
                 return False
+            _finish(status, str(e))
             return True
         if _is_stream_marker(result):
             try:
-                await self._write_stream(writer, replica, result[1], keep)
-            except Exception:
+                await self._write_stream(writer, replica, result[1], keep,
+                                         rid)
+            except Exception as e:
                 # Mid-stream failure: the chunked body is unterminated —
                 # drop the connection so framing can't desync.
+                _finish(500, str(e))
                 return False
+            _finish(200)
             return True
-        self._write_simple(writer, 200, json.dumps(result).encode(), keep)
+        self._write_simple(writer, 200, json.dumps(result).encode(), keep,
+                           rid)
+        _finish(200)
         return True
 
     async def _write_stream(self, writer, replica, stream_id: int,
-                            keep: bool) -> None:
+                            keep: bool, rid: Optional[str] = None) -> None:
         conn = b"keep-alive" if keep else b"close"
+        rid_hdr = (b"x-request-id: %s\r\n" % rid.encode("ascii")
+                   if rid else b"")
         writer.write(
             b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
-            b"Transfer-Encoding: chunked\r\nConnection: %s\r\n\r\n" % conn)
+            b"Transfer-Encoding: chunked\r\n%sConnection: %s\r\n\r\n"
+            % (rid_hdr, conn))
         done = False
         while not done:
             done, items = await self._aget(

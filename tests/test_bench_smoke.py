@@ -175,3 +175,19 @@ def test_bench_smoke_runs_all_stages():
         assert hf["actors_restarted_total"] >= 1, hf
         assert hf["recover_ms_p50"] > 0, hf
         assert hf["recover_ms_p99"] >= hf["recover_ms_p50"], hf
+
+    # Tracing-overhead A/B stage (ISSUE 20): paired traced/untraced
+    # child runs must both execute, the traced child must actually
+    # record spans, and the committed overhead figure must stay sane.
+    # The 5% budget is enforced against the FULL bench run (see
+    # BASELINE.md); smoke windows are short enough that scheduler noise
+    # dominates, so the smoke gate is deliberately loose.
+    assert "tracing_overhead_error" not in result, result
+    to = result["tracing_overhead"]
+    assert "error" not in to, to
+    assert to["tasks_per_s_traced"] > 0, to
+    assert to["tasks_per_s_untraced"] > 0, to
+    assert to["spans_traced"] > 0, to
+    assert to["spans_untraced"] == 0, to
+    assert len(to["pair_ratios"]) >= 2, to
+    assert to["overhead_frac"] <= 0.35, to

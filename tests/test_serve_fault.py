@@ -211,7 +211,11 @@ def test_request_deadline_typed_and_timely(serve_instance):
     t0 = time.monotonic()
     with pytest.raises((DeadlineExceededError, TaskError)) as ei:
         get(handle.remote(), timeout=30)
-    assert time.monotonic() - t0 < 3.0  # 0.6s deadline + slack, not 5s
+    # The bound proves the deadline beat the handler's 5s sleep; the
+    # slack is deliberately generous — at the tail of a full-suite run
+    # this host adds multi-second scheduling noise, and 3.0s flaked on
+    # clean trees (observed 3.2-3.5s elapsed, deadline itself on time).
+    assert time.monotonic() - t0 < 4.5  # 0.6s deadline + slack, not 5s
     root = ei.value
     while isinstance(root, TaskError) and root.cause is not None:
         root = root.cause
@@ -224,7 +228,7 @@ def test_request_deadline_typed_and_timely(serve_instance):
     assert hei.value.code == 504
     body = json.loads(hei.value.read())
     assert body.get("deadline_exceeded") is True
-    assert time.monotonic() - t0 < 3.0
+    assert time.monotonic() - t0 < 4.5
 
     # Per-request deadline via header beats the deployment default.
     req = urllib.request.Request("http://127.0.0.1:18311/slowpoke",
@@ -233,7 +237,7 @@ def test_request_deadline_typed_and_timely(serve_instance):
     with pytest.raises(urllib.error.HTTPError) as hei:
         urllib.request.urlopen(req, timeout=30)
     assert hei.value.code == 504
-    assert time.monotonic() - t0 < 2.0
+    assert time.monotonic() - t0 < 4.0  # 0.15s deadline, same noise floor
 
 
 def test_evicted_replica_releases_queue_depth(serve_instance):

@@ -1,0 +1,516 @@
+"""End-to-end request tracing (ISSUE 20).
+
+Covers the tentpole acceptance criterion — one HTTP request through the
+Serve proxy yields a span tree from >= 3 distinct processes under ONE
+trace id, queryable by the ``x-request-id`` the proxy returned, via
+``rt trace --json`` — plus the satellites: trace-store LRU/sampling/
+tail-retention units with counted evictions, the cross-process
+actor-submit trace regression, the replacement-head clean start, the
+metrics history ring behind ``/api/history`` / ``rt top``, and the
+``rt metrics --json`` / name-prefix filter.
+"""
+
+import contextlib
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+
+# ---------------------------------------------------------------- helpers
+
+def _ev(trace_id, name="s", dur_us=1000.0, span_id=None, parent=None,
+        error=None, ts=0.0, pid=4242):
+    """One chrome-form span event, the wire shape tracestore ingests."""
+    args = {"trace_id": trace_id, "span_id": span_id or os.urandom(8).hex(),
+            "parent_id": parent}
+    if error:
+        args["error"] = error
+    return {"name": name, "ph": "X", "cat": "span", "ts": ts,
+            "dur": dur_us, "pid": pid, "args": args}
+
+
+@contextlib.contextmanager
+def _cfg_env(**overrides):
+    """Apply RT_* config overrides for the block, then restore."""
+    from ray_tpu.core.config import Config
+
+    saved = {}
+    for k, v in overrides.items():
+        key = "RT_" + k.upper()
+        saved[key] = os.environ.get(key)
+        os.environ[key] = str(v)
+    Config.reset()
+    try:
+        yield
+    finally:
+        for key, v in saved.items():
+            if v is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = v
+        Config.reset()
+
+
+def _dropped(buffer: str) -> float:
+    from ray_tpu.observability.metrics import registry
+
+    entry = registry.collect_all().get("rt_telemetry_dropped_total")
+    if entry is None:
+        return 0.0
+    return float(entry[1].get((("buffer", buffer),), 0.0))
+
+
+def _kept(reason: str) -> float:
+    from ray_tpu.observability.metrics import registry
+
+    entry = registry.collect_all().get("rt_trace_store_kept_total")
+    if entry is None:
+        return 0.0
+    return float(entry[1].get((("reason", reason),), 0.0))
+
+
+# ------------------------------------------------------- trace store units
+
+def test_tracestore_lru_eviction_counted():
+    from ray_tpu.observability import tracestore
+
+    with _cfg_env(trace_store_max_traces=4, trace_sample_rate=1.0):
+        tracestore.clear()
+        base_drop, base_kept = _dropped("tracestore"), _kept("sampled")
+        ids = [f"trace{i:02d}" + "0" * 24 for i in range(6)]
+        for tid in ids:
+            tracestore.ingest_event(_ev(tid))
+        assert tracestore.stats()["traces"] == 4
+        # Oldest two evicted, newest four resident.
+        assert tracestore.get_trace(ids[0]) is None
+        assert tracestore.get_trace(ids[1]) is None
+        assert tracestore.get_trace(ids[-1]) is not None
+        assert _dropped("tracestore") - base_drop == 2
+        assert _kept("sampled") - base_kept == 6
+        tracestore.clear()
+
+
+def test_tracestore_sampling_deterministic_and_probation():
+    from ray_tpu.observability import tracestore
+
+    with _cfg_env(trace_sample_rate=0.5):
+        verdicts = {tid: tracestore.sampled(tid)
+                    for tid in (os.urandom(16).hex() for _ in range(64))}
+        # Deterministic: same id, same verdict, every time.
+        for tid, v in verdicts.items():
+            assert tracestore.sampled(tid) == v
+        # A 0.5 rate over 64 ids lands strictly between the extremes.
+        kept = sum(verdicts.values())
+        assert 0 < kept < 64
+    with _cfg_env(trace_sample_rate=0.0):
+        tracestore.clear()
+        for i in range(5):
+            tracestore.ingest_event(_ev(f"probation{i}" + "0" * 22))
+        st = tracestore.stats()
+        assert st["traces"] == 0  # sampled out: nothing admitted...
+        assert st["probation"] == 5  # ...but parked for tail retention
+        tracestore.clear()
+
+
+def test_tracestore_tail_retention_promotes_slow_and_errored():
+    from ray_tpu.observability import tracestore
+
+    with _cfg_env(trace_sample_rate=0.0, trace_slow_ms=100.0):
+        tracestore.clear()
+        base_tail = _kept("tail")
+        slow_tid, err_tid = "slowtrace" + "0" * 23, "errtrace" + "0" * 24
+        # Fast span first: parks on probation.
+        tracestore.ingest_event(_ev(slow_tid, name="fast", dur_us=50.0))
+        assert tracestore.stats()["traces"] == 0
+        # A slow span (>= trace_slow_ms) promotes the WHOLE trace,
+        # probation spans included.
+        tracestore.ingest_event(_ev(slow_tid, name="slow", dur_us=150e3))
+        data = tracestore.get_trace(slow_tid)
+        assert data is not None and data["retention"] == "tail"
+        assert {s["name"] for s in data["spans"]} == {"fast", "slow"}
+        # An errored span promotes too, regardless of duration.
+        tracestore.ingest_event(_ev(err_tid, dur_us=10.0, error="boom"))
+        err = tracestore.get_trace(err_tid)
+        assert err is not None and err["retention"] == "tail"
+        assert _kept("tail") - base_tail == 2
+        tracestore.clear()
+
+
+def test_tracestore_per_trace_span_cap_counted():
+    from ray_tpu.observability import tracestore
+
+    with _cfg_env(trace_sample_rate=1.0):
+        tracestore.clear()
+        base = _dropped("tracestore_spans")
+        tid = "capcheck" + "0" * 24
+        for _ in range(tracestore._SPANS_PER_TRACE_MAX + 20):
+            tracestore.ingest_event(_ev(tid))
+        data = tracestore.get_trace(tid)
+        assert len(data["spans"]) == tracestore._SPANS_PER_TRACE_MAX
+        assert _dropped("tracestore_spans") - base == 20
+        tracestore.clear()
+
+
+def test_tracer_ring_trim_counted():
+    """Satellite 2: the tracer's bounded ring counts trims in
+    rt_telemetry_dropped_total{buffer="tracer"} instead of silently
+    dropping the oldest spans."""
+    from ray_tpu.observability import tracing
+
+    tracer = tracing.Tracer(max_spans=8)
+    tracer.enable()
+    base = _dropped("tracer")
+    for i in range(11):
+        tracer.record(tracing.Span(
+            name=f"s{i}", span_id=f"{i:016x}", parent_id=None,
+            trace_id="t" * 32, start_s=0.0, end_s=1.0))
+    assert len(tracer.spans()) == 8
+    assert _dropped("tracer") - base == 3
+
+
+# ------------------------------------------------------- history ring unit
+
+def test_history_ring_rates_and_percentile_carry_forward():
+    from ray_tpu.observability import telemetry
+    from ray_tpu.observability.metrics import (Counter, Histogram,
+                                               get_or_create)
+
+    telemetry.clear_history()
+    tasks = get_or_create(Counter, "rt_tasks_finished", "Tasks finished",
+                          ("state",))
+    ttft = get_or_create(Histogram, "rt_llm_ttft_seconds",
+                         "Submit-to-first-token latency",
+                         boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                                     1.0, 5.0, 30.0])
+    t0 = 1_000_000.0
+    s0 = telemetry.record_history_sample(now=t0)
+    assert s0 is not None and s0["tasks_per_s"] == 0.0  # no prev sample
+    tasks.inc(30.0, tags={"state": "DONE"})
+    ttft.observe(0.03)
+    ttft.observe(0.03)
+    s1 = telemetry.record_history_sample(now=t0 + 10.0)
+    assert s1["tasks_per_s"] == pytest.approx(3.0)
+    # Window percentile interpolates inside the winning bucket
+    # (0.01..0.05 here).
+    assert 10.0 <= s1["ttft_p50_ms"] <= 50.0
+    p50 = s1["ttft_p50_ms"]
+    # Quiet window: no new observations -> the estimate carries forward
+    # instead of collapsing to zero between scrapes.
+    s2 = telemetry.record_history_sample(now=t0 + 20.0)
+    assert s2["ttft_p50_ms"] == p50
+    assert s2["tasks_per_s"] == 0.0
+    h = telemetry.history(limit=2)
+    assert [s["ts"] for s in h["samples"]] == [s1["ts"], s2["ts"]]
+    assert h["interval_ms"] > 0
+    telemetry.clear_history()
+    assert telemetry.history()["samples"] == []
+
+
+def test_history_ring_bounded():
+    from ray_tpu.observability import telemetry
+
+    telemetry.clear_history()
+    for i in range(telemetry._HISTORY_MAX + 25):
+        telemetry.record_history_sample(now=1_000_000.0 + i)
+    assert len(telemetry.history()["samples"]) == telemetry._HISTORY_MAX
+    telemetry.clear_history()
+
+
+# --------------------------------------------------- live-runtime fixtures
+
+@contextlib.contextmanager
+def _traced_runtime(**extra_env):
+    """Fresh runtime with tracing on (mirrors test_telemetry's helper);
+    restores config/env/tracer state afterwards."""
+    import ray_tpu as rt
+    from ray_tpu.core.config import Config
+    from ray_tpu.observability import telemetry, tracestore, tracing
+
+    if rt.is_initialized():
+        rt.shutdown()
+    overrides = {"RT_TRACING_ENABLED": "1",
+                 "RT_METRICS_REPORT_INTERVAL_MS": "200"}
+    overrides.update({"RT_" + k.upper(): str(v)
+                      for k, v in extra_env.items()})
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    Config.reset()
+    telemetry.clear()
+    rt.init(num_cpus=4)
+    try:
+        yield rt
+    finally:
+        rt.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        Config.reset()
+        tracing.disable()
+        tracing.get_tracer().clear()
+        tracing.get_tracer().on_record = None
+        tracestore.clear()
+        telemetry.clear()
+
+
+def _wait_trace(trace_id, pred, timeout=25.0):
+    from ray_tpu.observability import tracestore
+
+    deadline = time.monotonic() + timeout
+    data = None
+    while time.monotonic() < deadline:
+        data = tracestore.get_trace(trace_id)
+        if data is not None and pred(data):
+            return data
+        time.sleep(0.25)
+    return data
+
+
+# ----------------------------------------------------- tentpole e2e (HTTP)
+
+def test_serve_request_trace_spans_three_processes(capsys):
+    """THE acceptance criterion: one HTTP request -> `rt trace <rid>`
+    shows proxy -> router -> replica -> nested task spans from >= 3
+    distinct processes under the single trace id the proxy returned in
+    the x-request-id response header."""
+    with _traced_runtime():
+        import ray_tpu as rt
+        from ray_tpu import serve
+        from ray_tpu.scripts import cli
+
+        serve.start(http_port=18621)
+        try:
+            @rt.remote
+            def nested(x):
+                return x * 2
+
+            @serve.deployment
+            class Echo:
+                async def __call__(self, payload):
+                    # The nested task MUST join the request's trace:
+                    # its submit happens inside the replica's async
+                    # handler, two processes away from the proxy.
+                    ref = nested.remote(int(payload.get("x", 0)))
+                    from ray_tpu.core import get
+
+                    return {"doubled": get(ref, timeout=30)}
+
+            serve.run(Echo.bind(), name="Echo")
+            rid = "e2etrace" + os.urandom(8).hex()
+            body = json.dumps({"x": 21}).encode()
+            req = urllib.request.Request(
+                "http://127.0.0.1:18621/Echo", data=body,
+                headers={"Content-Type": "application/json",
+                         "x-request-id": rid})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert json.loads(r.read()) == {"doubled": 42}
+                # The proxy echoes the request id on the response.
+                assert r.headers.get("x-request-id") == rid
+
+            data = _wait_trace(rid, lambda d: len(d["procs"]) >= 3)
+            assert data is not None, "trace never landed in the store"
+            assert len(data["procs"]) >= 3, data["procs"]
+            names = {s["name"] for s in data["spans"]}
+            assert "proxy.request" in names
+            assert "router.assign" in names
+            assert "replica.handle" in names
+            assert any(n.startswith("task.execute") for n in names)
+            # Every span shares the request's trace id.
+            assert all(s["trace_id"] == rid for s in data["spans"])
+
+            # Same tree through the CLI (`rt trace <id> --json`).
+            assert cli.main(["trace", rid, "--json"]) == 0
+            out = json.loads(capsys.readouterr().out)
+            assert out["trace_id"] == rid
+            assert len(out["procs"]) >= 3
+            # Human rendering includes the proc labels.
+            assert cli.main(["trace", rid]) == 0
+            text = capsys.readouterr().out
+            assert "proxy.request" in text and "[driver]" in text
+
+            # A request WITHOUT a client id gets a minted one back.
+            req = urllib.request.Request(
+                "http://127.0.0.1:18621/Echo", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                minted = r.headers.get("x-request-id")
+            assert minted and len(minted) == 32
+        finally:
+            serve.shutdown()
+
+
+def test_actor_call_trace_crosses_processes():
+    """Satellite 1 regression: an actor method call stamps trace_ctx on
+    the submit path, so the worker-side execute span joins the
+    driver-side actor.submit span's trace."""
+    with _traced_runtime():
+        import ray_tpu as rt
+        from ray_tpu.observability import tracing
+
+        @rt.remote
+        class Ping:
+            def ping(self):
+                return "pong"
+
+        a = Ping.remote()
+        assert rt.get(a.ping.remote(), timeout=30) == "pong"
+        submit = next(s for s in tracing.get_tracer().spans("actor.submit")
+                      if "ping" in s.name)
+        data = _wait_trace(
+            submit.trace_id,
+            lambda d: any(s["name"].startswith("task.execute")
+                          for s in d["spans"]))
+        assert data is not None
+        execs = [s for s in data["spans"]
+                 if s["name"].startswith("task.execute")]
+        assert execs, data["spans"]
+        # The execute span ran in a different process than the driver.
+        assert execs[0]["pid"] != os.getpid()
+        assert len(data["procs"]) >= 2
+
+
+def test_replacement_head_starts_with_clean_trace_store():
+    """A replacement head after failover must not serve the dead
+    head's traces (mirrors flight.clear() in Runtime.__init__)."""
+    import ray_tpu as rt
+    from ray_tpu.observability import tracestore
+
+    if rt.is_initialized():
+        rt.shutdown()
+    stale = "stalehead" + "0" * 23
+    tracestore.ingest_event(_ev(stale))
+    assert tracestore.get_trace(stale) is not None
+    rt.init(num_cpus=1)
+    try:
+        assert tracestore.get_trace(stale) is None
+        assert tracestore.stats()["traces"] == 0
+    finally:
+        rt.shutdown()
+
+
+def test_llm_request_trace_engine_stage_spans_match_timing():
+    """The SlotEngine synthesizes llm.admission/queue/prefill/decode
+    child spans from the PR-16 timing metadata — the acceptance
+    criterion pins them to the response's own ``timing`` dict within
+    10%."""
+    with _traced_runtime():
+        from ray_tpu import serve
+        from ray_tpu.llm import build_llm_app
+
+        serve.start(http_port=18622)
+        try:
+            app = build_llm_app(model="llama-tiny", num_slots=2, chunk=8,
+                                seed=0, name="llmtrace")
+            serve.run(app)
+            rid = "llmtrace" + os.urandom(8).hex()
+            body = json.dumps({"prompt": [3, 141, 59, 26, 5],
+                               "max_tokens": 8}).encode()
+            req = urllib.request.Request(
+                "http://127.0.0.1:18622/llmtrace", data=body,
+                headers={"Content-Type": "application/json",
+                         "x-request-id": rid})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                out = json.loads(r.read())
+                assert r.headers.get("x-request-id") == rid
+            timing = out["timing"]
+            assert timing["total_s"] > 0
+
+            data = _wait_trace(
+                rid, lambda d: any(s["name"] == "llm.request"
+                                   for s in d["spans"]))
+            assert data is not None
+            spans = {s["name"]: s for s in data["spans"]}
+            assert "llm.request" in spans, sorted(spans)
+            # Engine spans came from the replica process, the proxy root
+            # from the head: >= 2 processes under the request's id.
+            assert len(data["procs"]) >= 2, data["procs"]
+            for stage in ("admission", "queue", "prefill", "decode"):
+                name = f"llm.{stage}"
+                assert name in spans, sorted(spans)
+                want_ms = timing[f"{stage}_s"] * 1e3
+                got_ms = spans[name]["dur_ms"]
+                assert got_ms == pytest.approx(want_ms, rel=0.1,
+                                               abs=0.05), (
+                    f"{name}: span {got_ms}ms vs timing {want_ms}ms")
+            assert spans["llm.request"]["dur_ms"] == pytest.approx(
+                timing["total_s"] * 1e3, rel=0.1, abs=0.1)
+            # Stage spans nest under the request span, which nests
+            # under the proxy root.
+            root_id = spans["llm.request"]["span_id"]
+            assert spans["llm.decode"]["parent_id"] == root_id
+            assert (spans["llm.request"]["parent_id"]
+                    == spans["proxy.request"]["span_id"])
+        finally:
+            serve.shutdown()
+
+
+# ----------------------------------------- dashboard routes and `rt top`
+
+def test_dashboard_history_traces_routes_and_rt_top(rt_shared, capsys):
+    from ray_tpu.observability import (start_dashboard, stop_dashboard,
+                                       telemetry, tracestore)
+    from ray_tpu.scripts import cli
+
+    tracestore.clear()
+    tracestore.ingest_event(_ev("dashtrace" + "0" * 23, name="root"))
+    telemetry.record_history_sample()
+    start_dashboard(port=18623)
+    try:
+        with urllib.request.urlopen(
+                "http://127.0.0.1:18623/api/history", timeout=10) as r:
+            hist = json.loads(r.read())
+        assert hist["interval_ms"] > 0
+        assert hist["samples"], "history ring empty"
+        sample = hist["samples"][-1]
+        for key in ("ts", "tasks_per_s", "tokens_per_s", "workers",
+                    "load_1m", "mem_used_frac"):
+            assert key in sample, sample
+        with urllib.request.urlopen(
+                "http://127.0.0.1:18623/api/traces", timeout=10) as r:
+            idx = json.loads(r.read())
+        assert idx["stats"]["traces"] >= 1
+        tid = idx["traces"][-1]["trace_id"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:18623/api/traces/{tid}",
+                timeout=10) as r:
+            one = json.loads(r.read())
+        assert one["trace_id"] == tid and one["spans"]
+        # `rt top --once`: one rendered frame over HTTP.
+        assert cli.main(["top", "--url", "http://127.0.0.1:18623",
+                         "--once"]) == 0
+        frame = capsys.readouterr().out
+        assert "tasks/s" in frame and "workers" in frame
+    finally:
+        stop_dashboard()
+        tracestore.clear()
+
+
+# ------------------------------------------------------------ rt metrics
+
+def test_rt_metrics_json_and_prefix_filter(rt_shared, capsys):
+    from ray_tpu.scripts import cli
+
+    assert cli.main(["metrics", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "rt_tasks_submitted" in out
+    entry = out["rt_tasks_submitted"]
+    assert entry["kind"] == "counter"
+    assert isinstance(entry["series"], list)
+    for s in entry["series"]:
+        assert set(s) == {"tags", "value"}
+
+    # Name-prefix filter narrows both forms.
+    assert cli.main(["metrics", "rt_workers_", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out and all(k.startswith("rt_workers_") for k in out)
+
+    assert cli.main(["metrics", "rt_workers_"]) == 0
+    text = capsys.readouterr().out
+    sample_lines = [ln for ln in text.splitlines()
+                    if ln and not ln.startswith("#")]
+    assert sample_lines
+    assert all(ln.startswith("rt_workers_") for ln in sample_lines)
